@@ -1,0 +1,4 @@
+from fks_tpu.data.entities import ClusterArrays, PodArrays, Workload
+from fks_tpu.data.traces import TraceParser, DEFAULT_TRACES_DIR
+
+__all__ = ["ClusterArrays", "PodArrays", "Workload", "TraceParser", "DEFAULT_TRACES_DIR"]

@@ -1,0 +1,51 @@
+"""Test harness configuration.
+
+Tests run on a virtual 8-device CPU mesh (the reference offers no
+distributed-test pattern; this is the TPU-mesh stand-in per SURVEY.md §4) and
+with x64 enabled so parity tests can evaluate policy arithmetic in float64,
+matching the reference's Python-float semantics. Framework code pins its own
+dtypes (int32/float32 by default) and accepts a dtype override.
+
+Env must be set before the first jax import.
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_enable_x64", True)
+
+import json  # noqa: E402
+import pathlib  # noqa: E402
+
+import pytest  # noqa: E402
+
+FIXTURES = pathlib.Path(__file__).parent / "fixtures"
+
+
+@pytest.fixture(scope="session")
+def golden_default():
+    with open(FIXTURES / "golden_default.json") as f:
+        return json.load(f)
+
+
+@pytest.fixture(scope="session")
+def golden_micro():
+    with open(FIXTURES / "golden_micro.json") as f:
+        return json.load(f)
+
+
+@pytest.fixture(scope="session")
+def golden_alt():
+    with open(FIXTURES / "golden_alt_traces.json") as f:
+        return json.load(f)
+
+
+@pytest.fixture(scope="session")
+def default_workload():
+    from fks_tpu.data import TraceParser
+    return TraceParser().parse_workload()

@@ -1,0 +1,135 @@
+#!/usr/bin/env python3
+"""Generate ground-truth fixtures by RUNNING the reference implementation.
+
+This script imports the reference (read-only, at /root/reference) and records
+its observable behavior into tests/fixtures/*.json. The fixtures are the
+parity bar for the TPU-native framework (fitness to 1e-5, exact event counts).
+
+No reference code is copied; we only execute it and record outputs.
+Reference entry points exercised:
+  - benchmarks/parser.py TraceParser.parse_workload
+  - simulator/main.py KubernetesSimulator.run_schedule
+  - simulator/evaluator.py SchedulingEvaluator.get_policy_score
+  - tests/test_scheduler.py policy zoo (imported as module)
+"""
+import json
+import os
+import sys
+import copy
+
+REF = "/root/reference"
+sys.path.insert(0, REF)
+sys.path.insert(0, os.path.join(REF, "tests"))
+
+os.chdir(REF)  # TraceParser uses relative paths
+
+from benchmarks.parser import TraceParser  # noqa: E402
+from simulator.event_simulator import DiscreteEventSimulator  # noqa: E402
+from simulator.main import KubernetesSimulator  # noqa: E402
+from simulator.evaluator import SchedulingEvaluator  # noqa: E402
+import test_scheduler as zoo  # noqa: E402
+import test_simulator as micro  # noqa: E402
+
+OUT = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", "tests", "fixtures")
+
+
+def run_policy(cluster, pods, policy, with_eval=True):
+    cluster = copy.deepcopy(cluster)
+    pods = copy.deepcopy(pods)
+    node_index = {nid: i for i, nid in enumerate(cluster.nodes_dict)}
+    ev = DiscreteEventSimulator(pods)
+    evaluator = SchedulingEvaluator(cluster, enabled=True) if with_eval else None
+    sim = KubernetesSimulator(cluster, pods, ev, policy, evaluator=evaluator)
+    sim.run_schedule()
+    out = {
+        "scheduled_pods": sum(1 for p in pods if p.assigned_node != ""),
+        "max_nodes": sim.max_nodes,
+        "assignments": [node_index.get(p.assigned_node, -1) for p in pods],
+        "assigned_gpus": [sorted(p.assigned_gpus) for p in pods],
+        "final_creation_time": [p.creation_time for p in pods],
+        "final_cpu_left": [n.cpu_milli_left for n in cluster.nodes_dict.values()],
+        "final_mem_left": [n.memory_mib_left for n in cluster.nodes_dict.values()],
+        "final_gpu_left": [n.gpu_left for n in cluster.nodes_dict.values()],
+        "final_gpu_milli_left": [[g.gpu_milli_left for g in n.gpus] for n in cluster.nodes_dict.values()],
+    }
+    if with_eval:
+        res = evaluator.get_evaluation_results()
+        out.update({
+            "policy_score": evaluator.get_policy_score(pods),
+            "avg_cpu_utilization": res.avg_cpu_utilization,
+            "avg_memory_utilization": res.avg_memory_utilization,
+            "avg_gpu_count_utilization": res.avg_gpu_count_utilization,
+            "avg_gpu_memory_utilization": res.avg_gpu_memory_utilization,
+            "gpu_fragmentation_score": res.gpu_fragmentation_score,
+            "num_snapshots": res.num_snapshots,
+            "num_fragmentation_events": res.num_fragmentation_events,
+            "events_processed": evaluator.events_processed,
+            "snapshots": [
+                [s.cpu_utilization, s.memory_utilization, s.gpu_count_utilization,
+                 s.gpu_memory_utilization, s.event_progress]
+                for s in evaluator.utilization_snapshots
+            ],
+            "fragmentation_events": evaluator.fragmentation_events,
+        })
+    return out
+
+
+def main():
+    os.makedirs(OUT, exist_ok=True)
+    parser = TraceParser()
+
+    policies = {
+        "first_fit": zoo.first_fit_scheduler,
+        "best_fit": zoo.best_fit_scheduler,
+        "funsearch_4901": zoo.funsearch_4901_scheduler,
+        "funsearch_4816": zoo.funsearch_4816_scheduler,
+        "funsearch_4800": zoo.funsearch_4800_scheduler,
+    }
+
+    # 1. Default workload, all 5 zoo policies.
+    cluster, pods = parser.parse_workload()
+    golden = {"trace": {"node_file": "gpu_models_filtered.csv",
+                        "pod_file": "openb_pod_list_default.csv",
+                        "num_nodes": len(cluster.nodes_dict),
+                        "num_pods": len(pods)},
+              "policies": {}}
+    for name, fn in policies.items():
+        print(f"running {name}...", flush=True)
+        golden["policies"][name] = run_policy(cluster, pods, fn)
+        print(f"  score={golden['policies'][name]['policy_score']:.6f} "
+              f"snaps={golden['policies'][name]['num_snapshots']}")
+    with open(os.path.join(OUT, "golden_default.json"), "w") as f:
+        json.dump(golden, f)
+
+    # 2. Alternate traces with best_fit + first_fit (robustness).
+    alt = {}
+    # NOTE: the multigpu* traces lack the gpu_spec/creation_time columns and the
+    # reference parser raises KeyError on them -- excluded (no parity obligation).
+    for pod_file in ["openb_pod_list_gpushare40.csv", "openb_pod_list_gpuspec33.csv",
+                     "openb_pod_list_cpu250.csv"]:
+        cluster2, pods2 = parser.parse_workload(pod_file=pod_file)
+        alt[pod_file] = {}
+        for name in ["first_fit", "best_fit"]:
+            print(f"running {name} on {pod_file}...", flush=True)
+            alt[pod_file][name] = run_policy(cluster2, pods2, policies[name])
+    with open(os.path.join(OUT, "golden_alt_traces.json"), "w") as f:
+        json.dump(alt, f)
+
+    # 3. Micro scenario (test_simulator.py): 2 nodes, 4 pods, no evaluator.
+    mc = micro.create_test_cluster()
+    mp = micro.create_test_pods()
+    m = run_policy(mc, mp, micro.best_fit_scheduler, with_eval=False)
+    m["pods"] = [
+        {"pod_id": p.pod_id, "cpu_milli": p.cpu_milli, "memory_mib": p.memory_mib,
+         "num_gpu": p.num_gpu, "gpu_milli": p.gpu_milli,
+         "creation_time": p.creation_time, "duration_time": p.duration_time}
+        for p in micro.create_test_pods()
+    ]
+    with open(os.path.join(OUT, "golden_micro.json"), "w") as f:
+        json.dump(m, f)
+
+    print("fixtures written to", OUT)
+
+
+if __name__ == "__main__":
+    main()
