@@ -35,8 +35,17 @@ import numpy as np
 
 from fks_tpu.data.entities import ClusterArrays, PodArrays, Workload
 
-# Repo-relative default: benchmarks/traces next to the package root.
-DEFAULT_TRACES_DIR = Path(__file__).resolve().parent.parent.parent / "benchmarks" / "traces"
+def _default_traces_dir() -> Path:
+    """benchmarks/traces next to the package root (source checkout), falling
+    back to the current working directory (the dataset is repo data, not
+    package data -- an installed wheel must point at a checkout or cwd)."""
+    checkout = Path(__file__).resolve().parent.parent.parent / "benchmarks" / "traces"
+    if checkout.is_dir():
+        return checkout
+    return Path.cwd() / "benchmarks" / "traces"
+
+
+DEFAULT_TRACES_DIR = _default_traces_dir()
 
 GPU_MILLI_CAPACITY = 1000  # per-GPU compute capacity (reference: parser.py:45-46)
 

@@ -1,0 +1,350 @@
+"""Hand-written scheduling-policy zoo, vectorized over the node axis.
+
+TPU-native re-design of the reference policy zoo: instead of a Python
+``(pod, node) -> int`` called N times per event (reference:
+tests/test_scheduler.py:20-218, funsearch_integration.py:217-431), each
+policy is a jit-traceable ``(PodView, NodeView) -> i32[N]`` scoring every
+node in one fused vector computation.
+
+Semantics notes (parity-critical):
+- every policy starts with the shared feasibility prologue (CPU/mem/GPU-count
+  then per-GPU milli check) and returns 0 for infeasible nodes;
+- ``max(1, int(score))`` truncates toward zero then clamps to >= 1
+  (so a feasible node NEVER scores 0);
+- arithmetic that the reference performs on Python ints (%, //) is done in
+  int32 here; float math happens in ``dtype`` (float64 reproduces Python
+  exactly; float32 is the TPU-fast default and matches on the shipped
+  traces).
+
+Factories return fresh closures so a dtype can be chosen per use.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from fks_tpu.sim.types import NodeView, PodView, PolicyFn
+
+_NEG = -1e30
+
+
+def feasible_mask(pod: PodView, nodes: NodeView):
+    """Shared feasibility prologue (reference test_scheduler.py:22-33 etc.):
+    resource fit + at least num_gpu GPUs with gpu_milli_left >= request."""
+    eligible = jnp.sum(
+        (nodes.gpu_mask & (nodes.gpu_milli_left >= pod.gpu_milli)).astype(jnp.int32),
+        axis=1)
+    gpu_ok = jnp.where(pod.num_gpu > 0, eligible >= pod.num_gpu, True)
+    return (nodes.node_mask
+            & (pod.cpu_milli <= nodes.cpu_milli_left)
+            & (pod.memory_mib <= nodes.memory_mib_left)
+            & (pod.num_gpu <= nodes.gpu_left)
+            & gpu_ok)
+
+
+def _finish(score, feasible):
+    """max(1, int(score)) under the feasibility gate."""
+    as_int = jnp.trunc(score).astype(jnp.int32)
+    return jnp.where(feasible, jnp.maximum(1, as_int), 0)
+
+
+def _safe(x, pred, fill=1):
+    return jnp.where(pred, x, fill)
+
+
+# --------------------------------------------------------------- baselines
+
+def first_fit(dtype=jnp.float32) -> PolicyFn:
+    """Constant 1000 when feasible (reference test_scheduler.py:203-218)."""
+
+    def policy(pod: PodView, nodes: NodeView):
+        return jnp.where(feasible_mask(pod, nodes), 1000, 0).astype(jnp.int32)
+
+    return policy
+
+
+def best_fit(dtype=jnp.float32) -> PolicyFn:
+    """Weighted 1 - normalized-remaining, x10000 (test_scheduler.py:171-200)."""
+
+    def policy(pod: PodView, nodes: NodeView):
+        f = feasible_mask(pod, nodes)
+        d = dtype
+        rem_cpu = (nodes.cpu_milli_left - pod.cpu_milli).astype(d)
+        rem_mem = (nodes.memory_mib_left - pod.memory_mib).astype(d)
+        rem_gpu = (nodes.gpu_left - pod.num_gpu).astype(d)
+        norm = (rem_cpu / nodes.cpu_milli_total.astype(d) * 0.33
+                + rem_mem / nodes.memory_mib_total.astype(d) * 0.33
+                + rem_gpu / jnp.maximum(nodes.num_gpus, 1).astype(d) * 0.34)
+        # reference computes int((1 - norm) * 10000) then max(1, .)
+        return _finish((1 - norm) * 10000, f)
+
+    return policy
+
+
+def worst_fit(dtype=jnp.float32) -> PolicyFn:
+    """Prefer the emptiest node (reference funsearch_integration.py:271-297,
+    shipped commented out of the seed list)."""
+
+    def policy(pod: PodView, nodes: NodeView):
+        f = feasible_mask(pod, nodes)
+        d = dtype
+        rem_cpu = (nodes.cpu_milli_left - pod.cpu_milli).astype(d) / nodes.cpu_milli_total.astype(d)
+        rem_mem = (nodes.memory_mib_left - pod.memory_mib).astype(d) / nodes.memory_mib_total.astype(d)
+        rem_gpu = (nodes.gpu_left - pod.num_gpu).astype(d) / jnp.maximum(nodes.num_gpus, 1).astype(d)
+        return _finish((rem_cpu * 0.33 + rem_mem * 0.33 + rem_gpu * 0.34) * 10000, f)
+
+    return policy
+
+
+def micro_best_fit(dtype=jnp.float32) -> PolicyFn:
+    """The micro-scenario best-fit: 1000000 // (sum remaining + 1), exact
+    integer floor division (reference tests/test_simulator.py:13-38)."""
+
+    def policy(pod: PodView, nodes: NodeView):
+        f = feasible_mask(pod, nodes)
+        rem = ((nodes.cpu_milli_left - pod.cpu_milli)
+               + (nodes.memory_mib_left - pod.memory_mib)
+               + (nodes.gpu_left - pod.num_gpu) + 1)
+        score = jnp.int32(1_000_000) // jnp.maximum(rem, 1)
+        return jnp.where(f, score, 0).astype(jnp.int32)
+
+    return policy
+
+
+def gpu_aware(dtype=jnp.float32) -> PolicyFn:
+    """GPU/CPU workload separation heuristic (funsearch_integration.py:299-353,
+    shipped commented out)."""
+
+    def policy(pod: PodView, nodes: NodeView):
+        f = feasible_mask(pod, nodes)
+        d = dtype
+        ngpus = jnp.maximum(nodes.num_gpus, 1).astype(d)
+        node_has_gpu = nodes.num_gpus > 0
+        pod_needs_gpu = pod.num_gpu > 0
+        cpu_util = 1 - nodes.cpu_milli_left.astype(d) / nodes.cpu_milli_total.astype(d)
+        mem_util = 1 - nodes.memory_mib_left.astype(d) / nodes.memory_mib_total.astype(d)
+        gpu_util = jnp.where(node_has_gpu, 1 - nodes.gpu_left.astype(d) / ngpus, 0)
+        rem_cpu = (nodes.cpu_milli_left - pod.cpu_milli).astype(d) / nodes.cpu_milli_total.astype(d)
+        rem_mem = (nodes.memory_mib_left - pod.memory_mib).astype(d) / nodes.memory_mib_total.astype(d)
+
+        # GPU-pod branch
+        base_g = jnp.where(gpu_util > 0.1, 1000 + 8000, 1000)
+        rem_gpu_norm = (nodes.gpu_left - pod.num_gpu).astype(d) / ngpus
+        sc_g = jnp.where(
+            gpu_util > 0.1,
+            base_g + jnp.trunc((1 - rem_gpu_norm) * 5000),
+            base_g + 2000.0)
+        sc_g = jnp.where((cpu_util > 0.1) & (gpu_util < 0.1),
+                         jnp.maximum(1.0, sc_g - 5000), sc_g)
+        sc_g = jnp.where(node_has_gpu, sc_g, 0.0)  # return 0 if no GPUs
+
+        # CPU-pod branch
+        sc_c_gpu_node = jnp.where(gpu_util > 0.1, 100.0, 1000.0)
+        base_c = 1000.0 + 5000.0
+        sc_c_plain = jnp.where(
+            cpu_util > 0.2,
+            base_c + jnp.trunc((1 - (rem_cpu + rem_mem) / 2) * 4000),
+            base_c + 2000.0)
+        sc_c = jnp.where(node_has_gpu, sc_c_gpu_node, sc_c_plain)
+
+        score = jnp.where(pod_needs_gpu, sc_g, sc_c)
+        balance = 1 - jnp.abs(rem_cpu - rem_mem)
+        score = score + jnp.trunc(balance * 1000)
+        # the gpu-pod/no-gpu-node case returned 0 before the balance bonus
+        gate = f & jnp.where(pod_needs_gpu, node_has_gpu, True)
+        return _finish(score, gate)
+
+    return policy
+
+
+def utilization_based(dtype=jnp.float32) -> PolicyFn:
+    """Size-adaptive hybrid best/worst fit (funsearch_integration.py:355-401,
+    shipped commented out)."""
+
+    def policy(pod: PodView, nodes: NodeView):
+        f = feasible_mask(pod, nodes)
+        d = dtype
+        ngpus = jnp.maximum(nodes.num_gpus, 1).astype(d)
+        pod_size = jnp.maximum(
+            jnp.maximum(pod.cpu_milli.astype(d) / nodes.cpu_milli_total.astype(d),
+                        pod.memory_mib.astype(d) / nodes.memory_mib_total.astype(d)),
+            pod.num_gpu.astype(d) / ngpus)
+        rem_cpu = (nodes.cpu_milli_left - pod.cpu_milli).astype(d) / nodes.cpu_milli_total.astype(d)
+        rem_mem = (nodes.memory_mib_left - pod.memory_mib).astype(d) / nodes.memory_mib_total.astype(d)
+        rem_gpu = (nodes.gpu_left - pod.num_gpu).astype(d) / ngpus
+        cur_util = 1 - jnp.minimum(
+            nodes.cpu_milli_left.astype(d) / nodes.cpu_milli_total.astype(d),
+            nodes.memory_mib_left.astype(d) / nodes.memory_mib_total.astype(d))
+
+        large = jnp.trunc((rem_cpu + rem_mem + rem_gpu) * 3333)
+        large = large + jnp.where(cur_util < 0.01, 5000.0, 0.0)
+        small_mid = jnp.trunc((1 - (rem_cpu + rem_mem + rem_gpu) / 3) * 10000) + 2000
+        small_hot = jnp.where(pod_size >= 0.1, 100.0, 8000.0)
+        small = jnp.where((cur_util > 0.3) & (cur_util < 0.9), small_mid,
+                          jnp.where(cur_util >= 0.9, small_hot, 100.0))
+        score = jnp.where(pod_size > 0.3, large, small)
+        return _finish(score, f)
+
+    return policy
+
+
+# ------------------------------------------------- FunSearch champion zoo
+
+def funsearch_4901(dtype=jnp.float32) -> PolicyFn:
+    """Champion, score 0.4901 (reference tests/test_scheduler.py:20-96)."""
+
+    def policy(pod: PodView, nodes: NodeView):
+        f = feasible_mask(pod, nodes)
+        d = dtype
+        gm = nodes.gpu_mask
+        pod_gpu = pod.num_gpu > 0
+
+        cpu_util = (nodes.cpu_milli_total - nodes.cpu_milli_left).astype(d) \
+            / nodes.cpu_milli_total.astype(d)
+        cpu_score = (1 - cpu_util) * jnp.where(cpu_util < 0.7, 100.0, 50.0)
+        mem_util = (nodes.memory_mib_total - nodes.memory_mib_left).astype(d) \
+            / nodes.memory_mib_total.astype(d)
+        mem_score = (1 - mem_util) * jnp.where(mem_util < 0.7, 100.0, 50.0)
+
+        free_milli = jnp.sum(jnp.where(gm, nodes.gpu_milli_left, 0), axis=1)
+        cap0 = nodes.gpu_milli_total[:, 0]  # node.gpus[0].gpu_milli_total
+        den = (nodes.gpu_left * cap0).astype(d)
+        gpu_util = (den - free_milli.astype(d)) / _safe(den, den != 0, 1)
+        gpu_score = jnp.where(
+            pod_gpu,
+            (1 - gpu_util) * jnp.where(gpu_util < 0.7, 200.0, 100.0), 0.0)
+
+        score = cpu_score + mem_score + gpu_score
+        # fragmentation penalty: (sum free milli) % pod.gpu_milli, int math
+        mod = jnp.where(pod.gpu_milli > 0,
+                        free_milli % jnp.maximum(pod.gpu_milli, 1), 0)
+        score = score - jnp.where(pod_gpu, mod.astype(d) * 0.2, 0.0)
+
+        low_cap = (nodes.cpu_milli_total < 2000) | (nodes.memory_mib_total < 12)
+        score = score - jnp.where(
+            low_cap,
+            (2000 - nodes.cpu_milli_total).astype(d) * 0.01
+            + (12 - nodes.memory_mib_total).astype(d) * 0.1, 0.0)
+
+        balance = jnp.abs(
+            nodes.cpu_milli_left.astype(d) / jnp.maximum(nodes.memory_mib_left, 1).astype(d)
+            - pod.cpu_milli.astype(d) / jnp.maximum(pod.memory_mib, 1).astype(d))
+        score = score - balance * 0.5
+
+        ample = (nodes.cpu_milli_left > pod.cpu_milli * 2) \
+            & (nodes.memory_mib_left > pod.memory_mib * 2)
+        score = score + jnp.where(ample, 25.0, 0.0)
+
+        gmax = jnp.max(jnp.where(gm, nodes.gpu_milli_left, -(2**30)), axis=1)
+        gmin = jnp.min(jnp.where(gm, nodes.gpu_milli_left, 2**30), axis=1)
+        imb = (gmax - gmin).astype(d)
+        score = score - jnp.where(pod_gpu, imb * 0.05, 0.0)
+
+        high_cap = (nodes.cpu_milli_total > 10000) & (nodes.memory_mib_total > 64)
+        score = score + jnp.where(high_cap, 15.0, 0.0)
+        nearly_full = (cpu_util > 0.9) | (mem_util > 0.9)
+        score = score - jnp.where(nearly_full, 20.0, 0.0)
+        return _finish(score, f)
+
+    return policy
+
+
+def funsearch_4816(dtype=jnp.float32) -> PolicyFn:
+    """Champion, score 0.4816 (reference tests/test_scheduler.py:99-131)."""
+
+    def policy(pod: PodView, nodes: NodeView):
+        f = feasible_mask(pod, nodes)
+        d = dtype
+        cpu_util = (nodes.cpu_milli_total - nodes.cpu_milli_left + pod.cpu_milli).astype(d) \
+            / jnp.maximum(nodes.cpu_milli_total, 1).astype(d)
+        mem_util = (nodes.memory_mib_total - nodes.memory_mib_left + pod.memory_mib).astype(d) \
+            / jnp.maximum(nodes.memory_mib_total, 1).astype(d)
+        balance = 1 - jnp.abs(cpu_util - mem_util)
+        efficiency = jnp.sqrt(cpu_util * mem_util)
+
+        # eligible = first num_gpu GPUs (slot order) with milli_left >= req
+        elig = nodes.gpu_mask & (nodes.gpu_milli_left >= pod.gpu_milli)
+        rank = jnp.cumsum(elig.astype(jnp.int32), axis=1) - 1
+        sel = elig & (rank < pod.num_gpu)
+        seli = sel.astype(jnp.int32)
+        sum_total = jnp.sum(nodes.gpu_milli_total * seli, axis=1)
+        sum_left = jnp.sum(nodes.gpu_milli_left * seli, axis=1)
+        nsel = jnp.sum(seli, axis=1)
+        gpu_util = (sum_total - sum_left + nsel * pod.gpu_milli).astype(d) \
+            / jnp.maximum(sum_total, 1).astype(d)
+        sq = (nodes.gpu_milli_left - pod.gpu_milli) ** 2
+        gpu_frag = jnp.sum(sq * seli, axis=1).astype(d) \
+            / jnp.maximum(sum_left, 1).astype(d)
+        isolation = 0.5 - jnp.abs(0.5 - jnp.sqrt(gpu_frag))
+        score_gpu = (cpu_util * 0.25 + mem_util * 0.15 + gpu_util * 0.45
+                     + balance * 0.05 + efficiency * 0.05
+                     - gpu_frag * 0.05 + isolation * 0.1) * 10000
+
+        frag_cpu = (nodes.cpu_milli_left % jnp.maximum(pod.cpu_milli, 1)).astype(d) \
+            / nodes.cpu_milli_total.astype(d)
+        frag_mem = (nodes.memory_mib_left % jnp.maximum(pod.memory_mib, 1)).astype(d) \
+            / nodes.memory_mib_total.astype(d)
+        frag = jnp.minimum(frag_cpu, frag_mem)
+        score_cpu = (cpu_util * 0.45 + mem_util * 0.35 + balance * 0.1
+                     + efficiency * 0.1 - frag * 0.1) * 10000
+
+        score = jnp.where(pod.num_gpu > 0, score_gpu, score_cpu)
+        return _finish(score, f)
+
+    return policy
+
+
+def funsearch_4800(dtype=jnp.float32) -> PolicyFn:
+    """Champion, score 0.4800 (reference tests/test_scheduler.py:134-167)."""
+
+    def policy(pod: PodView, nodes: NodeView):
+        f = feasible_mask(pod, nodes)
+        d = dtype
+        g = nodes.gpu_milli_left.shape[1]
+        cpu_util = (nodes.cpu_milli_total - nodes.cpu_milli_left + pod.cpu_milli).astype(d) \
+            / nodes.cpu_milli_total.astype(d)
+        mem_util = (nodes.memory_mib_total - nodes.memory_mib_left + pod.memory_mib).astype(d) \
+            / nodes.memory_mib_total.astype(d)
+        balance = (1 - jnp.abs(cpu_util - mem_util)) ** 2.5 * 300
+
+        # viable sorted by milli_left asc (stable), take num_gpu
+        elig = nodes.gpu_mask & (nodes.gpu_milli_left >= pod.gpu_milli)
+        iota = jnp.arange(g, dtype=jnp.int32)
+        key = jnp.where(elig, nodes.gpu_milli_left * g + iota, 2**30)
+        order = jnp.argsort(key, axis=1)
+        rank = jnp.zeros_like(key).at[
+            jnp.arange(key.shape[0])[:, None], order].set(iota[None, :])
+        sel = elig & (rank < pod.num_gpu)
+        eff_terms = 1 - (nodes.gpu_milli_left - pod.gpu_milli).astype(d) \
+            / jnp.maximum(nodes.gpu_milli_total, 1).astype(d)
+        gpu_eff = jnp.sum(jnp.where(sel, eff_terms, 0), axis=1) \
+            / jnp.maximum(pod.num_gpu, 1).astype(d)
+        n_viable = jnp.sum(elig.astype(jnp.int32), axis=1)
+        gpu_score = jnp.where((pod.num_gpu > 0) & (n_viable >= pod.num_gpu),
+                              gpu_eff ** 2 * 450, 0.0)
+
+        head = jnp.minimum(nodes.cpu_milli_left - pod.cpu_milli,
+                           nodes.memory_mib_left - pod.memory_mib).astype(d)
+        frag_score = jnp.maximum(head, 0) ** 0.6 \
+            / jnp.maximum(nodes.cpu_milli_total, nodes.memory_mib_total).astype(d) * 300
+        util_score = (jnp.minimum(cpu_util, mem_util) * 0.6
+                      + jnp.maximum(cpu_util, mem_util) * 0.4) * 600
+        return _finish(util_score + balance + gpu_score + frag_score, f)
+
+    return policy
+
+
+ZOO = {
+    "first_fit": first_fit,
+    "best_fit": best_fit,
+    "funsearch_4901": funsearch_4901,
+    "funsearch_4816": funsearch_4816,
+    "funsearch_4800": funsearch_4800,
+}
+
+BASELINE_FACTORIES = {
+    "first_fit": first_fit,
+    "best_fit": best_fit,
+    "worst_fit": worst_fit,
+    "gpu_aware": gpu_aware,
+    "utilization_based": utilization_based,
+}

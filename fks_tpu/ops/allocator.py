@@ -1,0 +1,43 @@
+"""GPU sub-allocation kernels: pick WHICH GPUs on the chosen node.
+
+Vectorized re-design of the reference's list-sort allocators
+(reference: simulator/main.py:150-199). Returns a boolean selection mask
+over the node's GPU slots instead of index lists.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+_BIG = jnp.int32(2**30)
+
+
+def best_fit_gpus(milli_left, gpu_mask, gpu_milli_req, num_gpu):
+    """Best-fit: the ``num_gpu`` eligible GPUs with the LEAST free milli,
+    ties by ascending slot index (reference main.py:150-177 -- Python's
+    stable sort on (milli_left,) preserves index order).
+
+    Args are one node's row: milli_left i32[G], gpu_mask bool[G], scalars.
+    Returns (select bool[G], ok bool). ``ok`` is False when fewer than
+    ``num_gpu`` eligible GPUs exist (the reference raises ValueError there,
+    main.py:164-165). For num_gpu == 0: empty selection, ok=True.
+    """
+    g = milli_left.shape[0]
+    iota = jnp.arange(g, dtype=jnp.int32)
+    eligible = gpu_mask & (milli_left >= gpu_milli_req)
+    # lexicographic (milli_left, index) key; ineligible sorted last
+    key = jnp.where(eligible, milli_left * g + iota, _BIG)
+    order = jnp.argsort(key)
+    rank = jnp.zeros(g, jnp.int32).at[order].set(iota)
+    select = eligible & (rank < num_gpu)
+    ok = jnp.sum(eligible.astype(jnp.int32)) >= num_gpu
+    return select, ok
+
+
+def first_fit_gpus(milli_left, gpu_mask, gpu_milli_req, num_gpu):
+    """First-fit: the first ``num_gpu`` eligible GPUs in slot order
+    (reference main.py:179-199, shipped as dead code -- kept for parity)."""
+    eligible = gpu_mask & (milli_left >= gpu_milli_req)
+    rank = jnp.cumsum(eligible.astype(jnp.int32)) - 1
+    select = eligible & (rank < num_gpu)
+    ok = jnp.sum(eligible.astype(jnp.int32)) >= num_gpu
+    return select, ok

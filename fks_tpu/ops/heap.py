@@ -1,0 +1,166 @@
+"""An exact, on-device replica of CPython's binary heap (``heapq``).
+
+Why this exists: the reference's event queue is a ``heapq`` of
+``(time, Event)`` tuples (reference: simulator/event_simulator.py:19-58), and
+one of its behaviors is *layout dependent*: when a pod cannot be placed, the
+retry time is taken from the first DELETION found in raw heap-array order
+(event_simulator.py:51-58), not in time order. To reproduce the reference's
+observable numbers exactly (snapshot counts, fragmentation series, fitness)
+we replicate the heap's array layout, which requires implementing CPython's
+exact sift algorithms (``heapq._siftdown`` / ``_siftup``; the C module
+mirrors the pure-Python ones).
+
+Keys are ``(time, tie_rank)`` int32 pairs compared lexicographically -- the
+reference compares tuples ``(time, Event)`` where ``Event.__lt__`` is pod-id
+string order (event_simulator.py:16-17); ``tie_rank`` is the precomputed rank
+of the pod id in lexicographic order, so integer comparison is equivalent.
+Payload is ``(kind, pod_index)`` with kind 0=CREATION, 1=DELETION.
+
+All ops are branchless/jit-safe: sift loops are ``lax.while_loop`` with
+data-dependent (but O(log n)-bounded) trip counts; everything vmaps.
+"""
+from __future__ import annotations
+
+import heapq
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+KIND_CREATE = 0
+KIND_DELETE = 1
+
+
+class EventHeap(NamedTuple):
+    """Array-backed binary min-heap of scheduling events."""
+
+    time: jax.Array  # i32[cap]
+    rank: jax.Array  # i32[cap] pod-id tie rank (secondary key)
+    kind: jax.Array  # i8[cap] 0=CREATE 1=DELETE
+    pod: jax.Array  # i32[cap] pod index
+    size: jax.Array  # i32[] live element count
+
+    @property
+    def capacity(self) -> int:
+        return self.time.shape[0]
+
+
+def _less(ta, ra, tb, rb):
+    """Lexicographic (time, rank) compare == reference tuple compare."""
+    return (ta < tb) | ((ta == tb) & (ra < rb))
+
+
+def heap_from_events(times, ranks, kinds, pods, capacity: int | None = None) -> EventHeap:
+    """Build the initial heap on host with CPython ``heapq.heapify`` itself.
+
+    The reference heapifies the CREATE events in pod-list order
+    (event_simulator.py:23-34); running the real ``heapq`` here guarantees an
+    identical starting layout. Host-side only (trace prep), so using the
+    stdlib is both simplest and exact.
+    """
+    items = [(int(t), int(r), int(k), int(p))
+             for t, r, k, p in zip(times, ranks, kinds, pods)]
+    heapq.heapify(items)  # (time, rank) unique per live pod => tuple order == key order
+    n = len(items)
+    cap = capacity or n
+    if cap < n:
+        raise ValueError(f"heap capacity {cap} < {n}")
+    arr = np.zeros((4, cap), dtype=np.int64)
+    if n:
+        arr[:, :n] = np.array(items, dtype=np.int64).T
+    return EventHeap(
+        time=jnp.asarray(arr[0], jnp.int32),
+        rank=jnp.asarray(arr[1], jnp.int32),
+        kind=jnp.asarray(arr[2], jnp.int8),
+        pod=jnp.asarray(arr[3], jnp.int32),
+        size=jnp.asarray(n, jnp.int32),
+    )
+
+
+def _get(h: EventHeap, i):
+    return h.time[i], h.rank[i], h.kind[i], h.pod[i]
+
+
+def _set(h: EventHeap, i, item) -> EventHeap:
+    t, r, k, p = item
+    return h._replace(
+        time=h.time.at[i].set(t),
+        rank=h.rank.at[i].set(r),
+        kind=h.kind.at[i].set(jnp.asarray(k, jnp.int8)),
+        pod=h.pod.at[i].set(p),
+    )
+
+
+def _siftdown(h: EventHeap, startpos, pos, newitem) -> EventHeap:
+    """CPython heapq._siftdown: bubble ``newitem`` up from ``pos``."""
+    nt, nr, _, _ = newitem
+
+    def cond(c):
+        h_, pos_ = c
+        parent = (pos_ - 1) >> 1
+        pt, pr, _, _ = _get(h_, jnp.maximum(parent, 0))
+        return (pos_ > startpos) & _less(nt, nr, pt, pr)
+
+    def body(c):
+        h_, pos_ = c
+        parent = (pos_ - 1) >> 1
+        h_ = _set(h_, pos_, _get(h_, parent))
+        return h_, parent
+
+    h, pos = jax.lax.while_loop(cond, body, (h, pos))
+    return _set(h, pos, newitem)
+
+
+def _siftup(h: EventHeap, pos, newitem, endpos) -> EventHeap:
+    """CPython heapq._siftup: walk the smaller child up to the root path from
+    ``pos``, then restore with ``_siftdown``. ``endpos`` is the live size."""
+    startpos = pos
+
+    def cond(c):
+        _, pos_, childpos = c
+        return childpos < endpos
+
+    def body(c):
+        h_, pos_, childpos = c
+        right = childpos + 1
+        ct, cr, _, _ = _get(h_, childpos)
+        rt, rr, _, _ = _get(h_, jnp.minimum(right, endpos - 1))
+        use_right = (right < endpos) & ~_less(ct, cr, rt, rr)
+        childpos = jnp.where(use_right, right, childpos)
+        h_ = _set(h_, pos_, _get(h_, childpos))
+        return h_, childpos, 2 * childpos + 1
+
+    h, pos, _ = jax.lax.while_loop(cond, body, (h, pos, 2 * pos + 1))
+    return _siftdown(h, startpos, pos, newitem)
+
+
+def heap_push(h: EventHeap, time, rank, kind, pod, pred=True) -> EventHeap:
+    """heapq.heappush; no-op when ``pred`` is False (for branchless callers)."""
+    pos = h.size
+    h2 = _siftdown(h._replace(size=h.size + 1), jnp.int32(0), pos,
+                   (time, rank, jnp.asarray(kind, jnp.int8), pod))
+    return jax.tree_util.tree_map(
+        lambda a, b: jnp.where(pred, a, b), h2, h)
+
+
+def heap_pop(h: EventHeap):
+    """heapq.heappop. Caller must ensure size > 0. Returns (heap, item)."""
+    item = _get(h, 0)
+    newsize = h.size - 1
+    last = _get(h, newsize)
+    # when newsize == 0 the sift degenerates to writing last back to slot 0,
+    # which equals the popped item -- harmless, matching heapq's early return.
+    h = _siftup(h._replace(size=newsize), jnp.int32(0), last, newsize)
+    return h, item
+
+
+def first_deletion_in_array_order(h: EventHeap):
+    """Reference ``repush_creation_event`` scan (event_simulator.py:51-58):
+    the first DELETION in raw backing-array order. Returns (found, time)."""
+    cap = h.capacity
+    idx = jnp.arange(cap, dtype=jnp.int32)
+    is_del = (h.kind == KIND_DELETE) & (idx < h.size)
+    pos = jnp.argmax(is_del)  # first True in array order
+    found = is_del[pos]
+    return found, h.time[pos]

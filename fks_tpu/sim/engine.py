@@ -1,0 +1,317 @@
+"""The jit-compiled discrete-event simulation engine.
+
+TPU-native re-design of the reference's Python event loop
+(reference: simulator/main.py:28-199 ``KubernetesSimulator`` +
+simulator/event_simulator.py ``DiscreteEventSimulator``): one
+``lax.while_loop`` whose body pops the next event from the exact on-device
+heap replica, applies the deletion-refund or creation-placement rule
+branchlessly, and folds the evaluator into the carry. Everything is fixed
+shape; the only data-dependent quantity is the trip count (== number of
+events processed, capped by ``max_steps``).
+
+Semantics replicated exactly (SURVEY.md §2 fine print):
+- strict-argmax placement with ``> 0`` gate, ties to the lowest node index
+  (main.py:104-111; node axis order == CSV order)
+- best-fit GPU sub-allocation, stable (milli, index) order (main.py:150-177)
+- retry re-push at (first DELETION in raw heap-array order).time + 1,
+  silently dropping the pod when no deletion exists (event_simulator.py:51-58)
+- pod.creation_time mutated on retry, so a delayed pod keeps its full
+  duration (event_simulator.py:45-58)
+- snapshot overshoot past 100% progress (see fks_tpu.sim.evaluator)
+- fragmentation event on every failed creation, scored over waiting GPU
+  pods' minimum gpu_milli (evaluator.py:69-75,144-163)
+- GPU-allocation shortfall aborts the run (reference raises ValueError,
+  main.py:164-165 -> caller maps to score 0, funsearch_integration.py:63-64)
+
+The policy is a vectorized ``PolicyFn`` scoring all nodes at once; the
+population axis is added OUTSIDE via ``vmap`` (see fks_tpu.parallel).
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fks_tpu.data.entities import ClusterArrays, PodArrays, Workload
+from fks_tpu.ops.allocator import best_fit_gpus, first_fit_gpus
+from fks_tpu.ops.heap import (
+    KIND_CREATE, KIND_DELETE, EventHeap,
+    first_deletion_in_array_order, heap_from_events, heap_pop, heap_push,
+)
+from fks_tpu.sim.evaluator import max_snapshot_count, snapshot_trigger_table
+from fks_tpu.sim.types import NodeView, PodView, PolicyFn, SimResult, SimState
+
+
+@dataclasses.dataclass(frozen=True)
+class SimConfig:
+    """Static simulation knobs (constructor args in the reference:
+    main.py:29-48, evaluator.py:30)."""
+
+    max_steps_factor: int = 8  # runaway guard: max events = factor * num_pods
+    max_steps: Optional[int] = None  # overrides the factor when set
+    snapshot_interval: float = 0.05
+    gpu_allocator: str = "best_fit"  # or "first_fit" (main.py:133-134)
+    score_dtype: Any = jnp.float32  # evaluator accumulation dtype
+    validate_invariants: bool = False  # reference main.py:201-272 (opt-in)
+
+    def resolve_max_steps(self, num_pods: int) -> int:
+        if self.max_steps is not None:
+            return self.max_steps
+        return max(64, self.max_steps_factor * num_pods)
+
+
+def initial_state(workload: Workload, cfg: SimConfig) -> SimState:
+    """Build the t=0 carry. Host-side; the initial heap layout is produced
+    by real CPython heapq so it matches the reference bit-for-bit."""
+    c, p = workload.cluster, workload.pods
+    n_real = p.num_pods
+    pm = np.asarray(p.pod_mask)
+    heap = heap_from_events(
+        np.asarray(p.creation_time)[pm], np.asarray(p.tie_rank)[pm],
+        np.zeros(n_real, np.int8), np.nonzero(pm)[0].astype(np.int32),
+        capacity=p.p_padded,
+    )
+    n, g, pp = c.n_padded, c.g_padded, p.p_padded
+    hist_size = int(max(1001, int(np.asarray(p.gpu_milli).max(initial=0)) + 2))
+    f = cfg.score_dtype
+    return SimState(
+        heap=heap,
+        cpu_left=jnp.asarray(c.cpu_total, jnp.int32),
+        mem_left=jnp.asarray(c.mem_total, jnp.int32),
+        gpu_left=jnp.asarray(c.gpu_declared, jnp.int32),
+        gpu_milli_left=jnp.asarray(c.gpu_milli_total, jnp.int32),
+        assigned_node=jnp.full(pp, -1, jnp.int32),
+        assigned_gpus=jnp.zeros(pp, jnp.uint32),
+        pod_ctime=jnp.asarray(p.creation_time, jnp.int32),
+        waiting=jnp.zeros(pp, bool),
+        wait_hist=jnp.zeros(hist_size, jnp.int32),
+        events_processed=jnp.int32(0),
+        snap_idx=jnp.int32(0),
+        snap_sums=jnp.zeros(4, f),
+        frag_sum=jnp.asarray(0, f),
+        frag_count=jnp.int32(0),
+        max_nodes=jnp.int32(0),
+        failed=jnp.bool_(False),
+        steps=jnp.int32(0),
+    )
+
+
+def _node_view(c: ClusterArrays, cpu_left, mem_left, gpu_left, gpu_milli_left):
+    return NodeView(
+        cpu_milli_left=cpu_left, cpu_milli_total=c.cpu_total,
+        memory_mib_left=mem_left, memory_mib_total=c.mem_total,
+        gpu_left=gpu_left, num_gpus=c.num_gpus,
+        gpu_milli_left=gpu_milli_left, gpu_milli_total=c.gpu_milli_total,
+        gpu_mem_total=c.gpu_mem_total, gpu_mask=c.gpu_mask,
+        node_mask=c.node_mask,
+    )
+
+
+def build_step(workload: Workload, policy: PolicyFn, cfg: SimConfig,
+               ktable) -> Callable[[SimState], SimState]:
+    """One event: the body of the while_loop. See module docstring."""
+    c, p = workload.cluster, workload.pods
+    # device-resident copies (parser emits numpy; tracers can't index numpy)
+    c = jax.tree_util.tree_map(jnp.asarray, c)
+    p = jax.tree_util.tree_map(jnp.asarray, p)
+    n, g = workload.cluster.n_padded, workload.cluster.g_padded
+    f = cfg.score_dtype
+    alloc = best_fit_gpus if cfg.gpu_allocator == "best_fit" else first_fit_gpus
+    totals = c.totals()
+    total_cpu, total_mem = totals["cpu"], totals["memory"]
+    total_gc, total_gm = totals["gpu_count"], totals["gpu_milli"]
+    g_iota = jnp.arange(g, dtype=jnp.uint32)
+    ktable = jnp.asarray(ktable, jnp.int32)
+    klen = ktable.shape[0]
+    hist_iota = None  # built lazily from state shape
+
+    def step(s: SimState) -> SimState:
+        h, (t, rk, kind, pod) = heap_pop(s.heap)
+        is_del = kind == jnp.int8(KIND_DELETE)
+        create = ~is_del
+
+        pcpu = p.cpu[pod]
+        pmem = p.mem[pod]
+        pngpu = p.num_gpu[pod]
+        pmilli = p.gpu_milli[pod]
+        pdur = p.duration[pod]
+
+        # ---- DELETION: refund resources (reference main.py:74-99)
+        a = jnp.where(is_del, s.assigned_node[pod], 0)
+        di = is_del.astype(jnp.int32)
+        cpu_left = s.cpu_left.at[a].add(di * pcpu)
+        mem_left = s.mem_left.at[a].add(di * pmem)
+        gpu_left = s.gpu_left.at[a].add(di * pngpu)
+        bits = s.assigned_gpus[pod]
+        sel_bits = ((bits >> g_iota) & 1).astype(jnp.int32)  # [G]
+        gpu_milli_left = s.gpu_milli_left.at[a].add(di * pmilli * sel_bits)
+
+        # ---- CREATION: score every node, strict argmax (main.py:101-111)
+        pod_view = PodView(pcpu, pmem, pngpu, pmilli, s.pod_ctime[pod], pdur)
+        node_view = _node_view(c, cpu_left, mem_left, gpu_left, gpu_milli_left)
+        scores = jnp.where(c.node_mask, policy(pod_view, node_view), 0)
+        b = jnp.argmax(scores).astype(jnp.int32)
+        placed = create & (scores[b] > 0)
+
+        # GPU sub-allocation on the winner (main.py:125-145)
+        sel, ok = alloc(gpu_milli_left[b], c.gpu_mask[b], pmilli, pngpu)
+        alloc_fail = placed & (pngpu > 0) & ~ok  # reference raises here
+        pl = placed & ~alloc_fail
+        pli = pl.astype(jnp.int32)
+        cpu_left = cpu_left.at[b].add(-pli * pcpu)
+        mem_left = mem_left.at[b].add(-pli * pmem)
+        gpu_left = gpu_left.at[b].add(-pli * pngpu)
+        gpu_milli_left = gpu_milli_left.at[b].add(-pli * pmilli * sel.astype(jnp.int32))
+
+        was_waiting = s.waiting[pod]
+        assigned_node = s.assigned_node.at[pod].set(
+            jnp.where(pl, b, s.assigned_node[pod]))
+        new_bits = jnp.sum(jnp.where(sel, jnp.uint32(1) << g_iota, jnp.uint32(0)),
+                           dtype=jnp.uint32)
+        assigned_gpus = s.assigned_gpus.at[pod].set(
+            jnp.where(pl, new_bits, bits))
+        heap2 = heap_push(h, t + pdur, rk, KIND_DELETE, pod, pred=pl)
+
+        # ---- failed creation: waiting set + fragmentation + retry
+        # (main.py:113-123, evaluator.py:69-75,144-163, event_simulator.py:51-58)
+        failp = create & ~placed
+        bucket = jnp.clip(pmilli, 0, s.wait_hist.shape[0] - 1)
+        hist = s.wait_hist.at[bucket].add(
+            (failp & ~was_waiting & (pngpu > 0)).astype(jnp.int32)
+            - (pl & was_waiting & (pngpu > 0)).astype(jnp.int32))
+        waiting = s.waiting.at[pod].set((was_waiting | failp) & ~pl)
+
+        hvals = hist > 0
+        has_gpu_waiting = jnp.any(hvals)
+        min_needed = jnp.argmax(hvals).astype(jnp.int32)  # first nonzero bucket
+        frag_free = jnp.where(
+            c.gpu_mask & (gpu_milli_left > 0) & (gpu_milli_left < min_needed),
+            gpu_milli_left, 0)
+        frag_score = jnp.where(
+            has_gpu_waiting & (total_gm > 0),
+            jnp.sum(frag_free, dtype=jnp.int64 if jnp.int64 == jnp.asarray(0).dtype else jnp.int32).astype(f)
+            / jnp.asarray(max(total_gm, 1), f),
+            jnp.asarray(0, f))
+        frag_sum = s.frag_sum + jnp.where(failp, frag_score, 0)
+        frag_count = s.frag_count + failp.astype(jnp.int32)
+
+        found, dt = first_deletion_in_array_order(heap2)
+        retry = failp & found
+        rt = dt + 1
+        pod_ctime = s.pod_ctime.at[pod].set(
+            jnp.where(retry, rt, s.pod_ctime[pod]))
+        heap3 = heap_push(heap2, rt, rk, KIND_CREATE, pod, pred=retry)
+
+        # ---- evaluator bookkeeping (main.py:63-72, evaluator.py:55-67).
+        # On alloc_fail the reference raises BEFORE record_event_processed.
+        valid = ~alloc_fail
+        events = s.events_processed + valid.astype(jnp.int32)
+        fire = valid & (s.snap_idx < klen) & (
+            events >= ktable[jnp.minimum(s.snap_idx, klen - 1)])
+        used = jnp.stack([
+            jnp.asarray(total_cpu - jnp.sum(cpu_left), f),
+            jnp.asarray(total_mem - jnp.sum(mem_left), f),
+            jnp.asarray(jnp.sum(c.num_gpus - gpu_left), f),
+            jnp.asarray(total_gm - jnp.sum(gpu_milli_left), f),
+        ])
+        denom = jnp.asarray(
+            [max(total_cpu, 1), max(total_mem, 1), max(total_gc, 1),
+             max(total_gm, 1)], f)
+        zero_total = jnp.asarray(
+            [total_cpu <= 0, total_mem <= 0, total_gc <= 0, total_gm <= 0], bool)
+        utils = jnp.where(zero_total, 0, used / denom)
+        snap_sums = s.snap_sums + jnp.where(fire, utils, 0)
+        snap_idx = s.snap_idx + fire.astype(jnp.int32)
+
+        active = jnp.sum((c.node_mask & (
+            (cpu_left < c.cpu_total) | (mem_left < c.mem_total)
+            | (gpu_left < c.num_gpus))), dtype=jnp.int32)
+        max_nodes = jnp.maximum(s.max_nodes, jnp.where(valid, active, 0))
+
+        return SimState(
+            heap=heap3, cpu_left=cpu_left, mem_left=mem_left,
+            gpu_left=gpu_left, gpu_milli_left=gpu_milli_left,
+            assigned_node=assigned_node, assigned_gpus=assigned_gpus,
+            pod_ctime=pod_ctime, waiting=waiting, wait_hist=hist,
+            events_processed=events, snap_idx=snap_idx, snap_sums=snap_sums,
+            frag_sum=frag_sum, frag_count=frag_count, max_nodes=max_nodes,
+            failed=s.failed | alloc_fail, steps=s.steps + 1,
+        )
+
+    return step
+
+
+def _gpu_count_used(c: ClusterArrays, gpu_left):
+    return jnp.sum(c.num_gpus - gpu_left)
+
+
+def finalize(workload: Workload, cfg: SimConfig, s: SimState) -> SimResult:
+    """Fitness + results (reference evaluator.py:77-127)."""
+    p = workload.pods
+    f = cfg.score_dtype
+    pod_mask = jnp.asarray(p.pod_mask)
+    n_snap = s.snap_idx
+    denom = jnp.maximum(n_snap, 1).astype(f)
+    avg = s.snap_sums / denom
+    frag_mean = jnp.where(
+        s.frag_count > 0, s.frag_sum / jnp.maximum(s.frag_count, 1).astype(f),
+        jnp.asarray(0, f))
+    all_assigned = jnp.all((s.assigned_node >= 0) | ~pod_mask)
+    truncated = (s.heap.size > 0) & ~s.failed
+    overall = jnp.sum(avg) / 4
+    raw = jnp.clip(overall - jnp.minimum(jnp.asarray(0.1, f), frag_mean), 0.0, 1.0)
+    score = jnp.where(
+        (n_snap > 0) & all_assigned & ~s.failed & ~truncated, raw,
+        jnp.asarray(0, f))
+    scheduled = jnp.sum((s.assigned_node >= 0) & pod_mask, dtype=jnp.int32)
+    return SimResult(
+        policy_score=score,
+        avg_cpu_utilization=avg[0], avg_memory_utilization=avg[1],
+        avg_gpu_count_utilization=avg[2], avg_gpu_memory_utilization=avg[3],
+        gpu_fragmentation_score=frag_mean,
+        num_snapshots=n_snap, num_fragmentation_events=s.frag_count,
+        events_processed=s.events_processed, scheduled_pods=scheduled,
+        max_nodes=s.max_nodes, assigned_node=s.assigned_node,
+        assigned_gpus=s.assigned_gpus, pod_ctime=s.pod_ctime,
+        cpu_left=s.cpu_left, mem_left=s.mem_left, gpu_left=s.gpu_left,
+        gpu_milli_left=s.gpu_milli_left, failed=s.failed, truncated=truncated,
+        invariant_violations=jnp.int32(0),
+    )
+
+
+def make_run_fn(workload: Workload, policy: PolicyFn,
+                cfg: SimConfig = SimConfig()):
+    """Build the jittable end-to-end run: initial state -> SimResult.
+
+    The returned fn takes the initial SimState (so callers can vmap over
+    batched states or donate buffers) and returns a SimResult.
+    """
+    num_pods = workload.num_pods
+    max_steps = cfg.resolve_max_steps(num_pods)
+    ktable = snapshot_trigger_table(
+        num_pods, max_snapshot_count(max_steps, num_pods, cfg.snapshot_interval),
+        cfg.snapshot_interval)
+    step = build_step(workload, policy, cfg, ktable)
+
+    def cond(s: SimState):
+        return (s.heap.size > 0) & ~s.failed & (s.steps < max_steps)
+
+    def run(state: SimState) -> SimResult:
+        final = jax.lax.while_loop(cond, step, state)
+        return finalize(workload, cfg, final)
+
+    return run
+
+
+def simulate(workload: Workload, policy: PolicyFn,
+             cfg: SimConfig = SimConfig(), jit: bool = True) -> SimResult:
+    """Host convenience API: the reference's 'build simulator, run_schedule,
+    get results' flow (main.py:29-72 + evaluator read-out) in one call."""
+    run = make_run_fn(workload, policy, cfg)
+    if jit:
+        run = jax.jit(run)
+    return run(initial_state(workload, cfg))

@@ -1,0 +1,105 @@
+"""Simulation-facing views and state pytrees.
+
+``PodView``/``NodeView`` are the policy interface -- the TPU-native
+re-design of the reference's ``PodNodeScorer = Callable[[Pod, Node], int]``
+(reference: simulator/main.py:8). Instead of one (pod, node) pair per call,
+a policy scores ONE pod against ALL nodes at once: vectorized over the node
+axis, jit-traceable, and therefore fusible into the simulation step.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple
+
+from fks_tpu.ops.heap import EventHeap
+
+
+class PodView(NamedTuple):
+    """Scalar features of the pod being scheduled (reference Pod fields,
+    simulator/entities.py:29-43)."""
+
+    cpu_milli: Any
+    memory_mib: Any
+    num_gpu: Any
+    gpu_milli: Any
+    creation_time: Any  # as mutated by retries (event_simulator.py:56)
+    duration_time: Any
+
+
+class NodeView(NamedTuple):
+    """Per-node state arrays, axis N (+ per-GPU axis G).
+
+    Mirrors reference Node/GPU observable fields (simulator/entities.py:4-21).
+    ``gpu_mem_total`` never changes during simulation (the reference never
+    allocates GPU memory, only milli), so there is no ``gpu_mem_left``.
+    """
+
+    cpu_milli_left: Any  # i32[N]
+    cpu_milli_total: Any  # i32[N]
+    memory_mib_left: Any  # i32[N]
+    memory_mib_total: Any  # i32[N]
+    gpu_left: Any  # i32[N] (starts at declared count, parser.py:56)
+    num_gpus: Any  # i32[N] == len(node.gpus)
+    gpu_milli_left: Any  # i32[N, G]
+    gpu_milli_total: Any  # i32[N, G]
+    gpu_mem_total: Any  # i32[N, G]
+    gpu_mask: Any  # bool[N, G]
+    node_mask: Any  # bool[N]
+
+
+# A policy scores one pod against every node; 0 means "cannot/refuse"
+# (strict-argmax > 0 gate, reference main.py:104-111).
+PolicyFn = Callable[[PodView, NodeView], Any]  # -> i32[N]
+
+
+class SimState(NamedTuple):
+    """The lax.while_loop carry: complete simulation + evaluator state."""
+
+    heap: EventHeap
+    # cluster (reference Node/GPU mutable fields)
+    cpu_left: Any  # i32[N]
+    mem_left: Any  # i32[N]
+    gpu_left: Any  # i32[N]
+    gpu_milli_left: Any  # i32[N, G]
+    # pod scheduling state (reference Pod.assigned_*, entities.py:42-43)
+    assigned_node: Any  # i32[P], -1 = unassigned
+    assigned_gpus: Any  # u32[P] bitmask over G
+    pod_ctime: Any  # i32[P] creation_time (mutated on retry)
+    waiting: Any  # bool[P] membership of waiting_pods (main.py:43)
+    wait_hist: Any  # i32[M] histogram of gpu_milli of waiting GPU pods
+    # evaluator accumulators (reference SchedulingEvaluator)
+    events_processed: Any  # i32
+    snap_idx: Any  # i32 number of snapshots taken
+    snap_sums: Any  # f[4] summed cpu/mem/gpu-count/gpu-milli utilization
+    frag_sum: Any  # f[] sum of fragmentation event scores
+    frag_count: Any  # i32
+    max_nodes: Any  # i32 peak active-node count (main.py:67-72)
+    # control
+    failed: Any  # bool: GPU allocation raised in the reference -> abort
+    steps: Any  # i32
+
+
+class SimResult(NamedTuple):
+    """Final observables; superset of reference EvaluationResults
+    (evaluator.py:16-25) + policy score + run metadata."""
+
+    policy_score: Any
+    avg_cpu_utilization: Any
+    avg_memory_utilization: Any
+    avg_gpu_count_utilization: Any
+    avg_gpu_memory_utilization: Any
+    gpu_fragmentation_score: Any
+    num_snapshots: Any
+    num_fragmentation_events: Any
+    events_processed: Any
+    scheduled_pods: Any
+    max_nodes: Any
+    assigned_node: Any  # i32[P]
+    assigned_gpus: Any  # u32[P] bitmask
+    pod_ctime: Any  # i32[P] final (retry-mutated) creation times
+    cpu_left: Any  # i32[N] final node state
+    mem_left: Any
+    gpu_left: Any
+    gpu_milli_left: Any  # i32[N, G]
+    failed: Any  # bool
+    truncated: Any  # bool: hit max_steps with events remaining
+    invariant_violations: Any  # i32 (0 unless validate_invariants)

@@ -10,13 +10,21 @@ Env must be set before the first jax import.
 """
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU for tests even when the environment points at a TPU tunnel
+# (JAX_PLATFORMS=axon in this image): tests model the mesh with 8 virtual
+# CPU devices; only bench.py runs on the real chip.
+#
+# NOTE: in this image /root/.axon_site/sitecustomize.py imports jax at
+# interpreter startup, so env vars are too late -- use jax.config.update
+# (effective until the first backend initialization). XLA_FLAGS is read at
+# backend creation, so setting it here still works.
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 
 import jax  # noqa: E402
 
+jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
 
 import json  # noqa: E402

@@ -1,0 +1,54 @@
+"""Golden micro test: the reference's 2-node/4-pod scenario
+(reference: tests/test_simulator.py) must produce identical placements,
+GPU selections, and final cluster state."""
+import jax.numpy as jnp
+import numpy as np
+
+from fks_tpu.data.build import make_workload
+from fks_tpu.models.zoo import micro_best_fit
+from fks_tpu.sim.engine import SimConfig, simulate
+
+
+def micro_workload():
+    nodes = [
+        {"node_id": "node1", "cpu_milli": 8000, "memory_mib": 16000,
+         "gpus": [1000, 1000], "gpu_memory_mib": 8000},
+        {"node_id": "node2", "cpu_milli": 4000, "memory_mib": 8000, "gpus": []},
+    ]
+    pods = [
+        {"pod_id": "pod1", "cpu_milli": 1000, "memory_mib": 2000, "num_gpu": 0,
+         "gpu_milli": 0, "creation_time": 0, "duration_time": 10},
+        {"pod_id": "pod2", "cpu_milli": 2000, "memory_mib": 4000, "num_gpu": 1,
+         "gpu_milli": 500, "creation_time": 5, "duration_time": 15},
+        {"pod_id": "pod3", "cpu_milli": 3000, "memory_mib": 6000, "num_gpu": 0,
+         "gpu_milli": 0, "creation_time": 10, "duration_time": 8},
+        {"pod_id": "pod4", "cpu_milli": 1500, "memory_mib": 3000, "num_gpu": 2,
+         "gpu_milli": 400, "creation_time": 15, "duration_time": 12},
+    ]
+    return make_workload(nodes, pods, pad_nodes_to=4, pad_gpus_to=4, pad_pods_to=8)
+
+
+def bits_to_indices(bits):
+    return sorted(i for i in range(32) if (int(bits) >> i) & 1)
+
+
+def test_micro_matches_reference(golden_micro):
+    wl = micro_workload()
+    res = simulate(wl, micro_best_fit(dtype=jnp.float64),
+                   SimConfig(score_dtype=jnp.float64))
+    assert not bool(res.failed)
+    assert not bool(res.truncated)
+    n_pods = wl.num_pods
+    got_nodes = np.asarray(res.assigned_node)[:n_pods].tolist()
+    assert got_nodes == golden_micro["assignments"]
+    got_gpus = [bits_to_indices(b) for b in np.asarray(res.assigned_gpus)[:n_pods]]
+    assert got_gpus == golden_micro["assigned_gpus"]
+    assert int(res.scheduled_pods) == golden_micro["scheduled_pods"]
+    assert int(res.max_nodes) == golden_micro["max_nodes"]
+    n = wl.num_nodes
+    assert np.asarray(res.cpu_left)[:n].tolist() == golden_micro["final_cpu_left"]
+    assert np.asarray(res.mem_left)[:n].tolist() == golden_micro["final_mem_left"]
+    assert np.asarray(res.gpu_left)[:n].tolist() == golden_micro["final_gpu_left"]
+    gml = np.asarray(res.gpu_milli_left)
+    for i, row in enumerate(golden_micro["final_gpu_milli_left"]):
+        assert gml[i, :len(row)].tolist() == row
