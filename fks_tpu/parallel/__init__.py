@@ -1,0 +1,13 @@
+"""Population/mesh parallelism: the framework's distributed backend.
+
+Replaces the reference's ProcessPoolExecutor fan-out (reference:
+funsearch/funsearch_integration.py:535-562) with ``vmap`` on-chip and
+``shard_map`` + ICI all-gather across a ``jax.sharding.Mesh``.
+"""
+from fks_tpu.parallel.population import (  # noqa: F401
+    ParamPolicyFn, fitness, make_population_eval, make_single_run,
+)
+from fks_tpu.parallel.mesh import (  # noqa: F401
+    POP_AXIS, make_sharded_eval, make_sharded_generation_step,
+    pad_population, population_mesh,
+)

@@ -115,13 +115,16 @@ def build_step(workload: Workload, policy: PolicyFn, cfg: SimConfig,
                ktable) -> Callable[[SimState], SimState]:
     """One event: the body of the while_loop. See module docstring."""
     c, p = workload.cluster, workload.pods
+    # host-side totals first: build_step may run under an active trace (the
+    # population layer calls it with tracer params), where converted arrays
+    # can no longer round-trip through numpy
+    totals = c.totals()
     # device-resident copies (parser emits numpy; tracers can't index numpy)
     c = jax.tree_util.tree_map(jnp.asarray, c)
     p = jax.tree_util.tree_map(jnp.asarray, p)
     n, g = workload.cluster.n_padded, workload.cluster.g_padded
     f = cfg.score_dtype
     alloc = best_fit_gpus if cfg.gpu_allocator == "best_fit" else first_fit_gpus
-    totals = c.totals()
     total_cpu, total_mem = totals["cpu"], totals["memory"]
     total_gc, total_gm = totals["gpu_count"], totals["gpu_milli"]
     g_iota = jnp.arange(g, dtype=jnp.uint32)
@@ -283,6 +286,36 @@ def finalize(workload: Workload, cfg: SimConfig, s: SimState) -> SimResult:
     )
 
 
+def make_param_run_fn(workload: Workload, param_policy, cfg: SimConfig = SimConfig()):
+    """Build ``run(params, state) -> SimResult`` for a parameterized policy
+    ``(params, PodView, NodeView) -> i32[N]``.
+
+    This is the single loop-assembly point (ktable sizing, termination
+    predicate, while_loop + finalize) shared by the plain path below and the
+    population/mesh layers (fks_tpu.parallel), so fitness semantics cannot
+    diverge between them. ``params`` may be a tracer: the step closure is
+    rebuilt under the caller's trace, which is what lets ``vmap`` add the
+    population axis outside.
+    """
+    num_pods = workload.num_pods
+    max_steps = cfg.resolve_max_steps(num_pods)
+    ktable = snapshot_trigger_table(
+        num_pods, max_snapshot_count(max_steps, num_pods, cfg.snapshot_interval),
+        cfg.snapshot_interval)
+
+    def cond(s: SimState):
+        return (s.heap.size > 0) & ~s.failed & (s.steps < max_steps)
+
+    def run(params, state: SimState) -> SimResult:
+        step = build_step(
+            workload, lambda pod, nodes: param_policy(params, pod, nodes),
+            cfg, ktable)
+        final = jax.lax.while_loop(cond, step, state)
+        return finalize(workload, cfg, final)
+
+    return run
+
+
 def make_run_fn(workload: Workload, policy: PolicyFn,
                 cfg: SimConfig = SimConfig()):
     """Build the jittable end-to-end run: initial state -> SimResult.
@@ -290,21 +323,8 @@ def make_run_fn(workload: Workload, policy: PolicyFn,
     The returned fn takes the initial SimState (so callers can vmap over
     batched states or donate buffers) and returns a SimResult.
     """
-    num_pods = workload.num_pods
-    max_steps = cfg.resolve_max_steps(num_pods)
-    ktable = snapshot_trigger_table(
-        num_pods, max_snapshot_count(max_steps, num_pods, cfg.snapshot_interval),
-        cfg.snapshot_interval)
-    step = build_step(workload, policy, cfg, ktable)
-
-    def cond(s: SimState):
-        return (s.heap.size > 0) & ~s.failed & (s.steps < max_steps)
-
-    def run(state: SimState) -> SimResult:
-        final = jax.lax.while_loop(cond, step, state)
-        return finalize(workload, cfg, final)
-
-    return run
+    run = make_param_run_fn(workload, lambda _p, pod, nodes: policy(pod, nodes), cfg)
+    return functools.partial(run, None)
 
 
 def simulate(workload: Workload, policy: PolicyFn,
