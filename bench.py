@@ -1,0 +1,100 @@
+"""Headline benchmark: candidate-policy evaluations/sec on the default trace.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+What is measured: the full reference workload (16 nodes x 8,152 pods,
+reference: benchmarks/traces/csv/openb_pod_list_default.csv) evaluated for a
+population of parametric scheduling policies as a single vmapped XLA
+program — the unit of work the reference performs per candidate in its
+ProcessPoolExecutor (reference: funsearch/funsearch_integration.py:30-64:
+re-parse trace, deep-copy state, run the Python event loop, ~0.2 s/eval,
+SURVEY.md §6). Baseline: the reference's best implied throughput on its own
+benchmark, max_workers(8) / 0.2 s = 40 evals/s/host.
+
+A fitness-parity gate runs first (first_fit == 0.4292 etc. to 1e-5,
+reference README.md:25-31 table); the benchmark refuses to report a number
+from a simulator that disagrees with the reference.
+
+Env knobs: FKS_BENCH_POP (population size, default 16 — the axon TPU tunnel
+kills device executions past ~60 s, which caps the per-call batch), and
+FKS_BENCH_REPS (timed repetitions, default 3).
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+BASELINE_EVALS_PER_SEC = 40.0  # reference: 8 workers / 0.2 s per eval
+PARITY = {"first_fit": 0.4292, "best_fit": 0.4465, "funsearch_4901": 0.4901}
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from fks_tpu.data import TraceParser
+    from fks_tpu.models import parametric, zoo
+    from fks_tpu.parallel import make_population_eval
+    from fks_tpu.sim.engine import SimConfig, simulate
+
+    pop_size = int(os.environ.get("FKS_BENCH_POP", "16"))
+    reps = int(os.environ.get("FKS_BENCH_REPS", "3"))
+    dev = jax.devices()[0]
+    log(f"device: {dev.platform} ({dev.device_kind}); pop={pop_size} reps={reps}")
+
+    wl = TraceParser().parse_workload()
+    log(f"workload: {wl.num_nodes} nodes x {wl.num_pods} pods")
+
+    # ---- parity gate (scores are float32 on device; 1e-4 absolute covers
+    # the README's 4-digit reporting precision)
+    for name, want in PARITY.items():
+        got = float(simulate(wl, zoo.ZOO[name]()).policy_score)
+        if abs(got - want) > 1e-4:
+            log(f"PARITY FAIL {name}: got {got:.6f} want {want:.4f}")
+            print(json.dumps({
+                "metric": "candidate policy evaluations/sec (8152-pod trace)",
+                "value": 0.0, "unit": "evals/s", "vs_baseline": 0.0,
+                "error": f"fitness parity failed for {name}"}))
+            return 1
+        log(f"parity ok {name}: {got:.4f}")
+
+    # ---- throughput: one vmapped program evaluating the whole population
+    key = jax.random.PRNGKey(0)
+    params = parametric.init_population(key, pop_size, noise=0.1)
+    ev = make_population_eval(wl, cfg=SimConfig())
+    t0 = time.perf_counter()
+    res = ev(params)
+    jax.block_until_ready(res.policy_score)
+    t_compile = time.perf_counter() - t0
+    log(f"first call (compile+run): {t_compile:.1f}s; "
+        f"scores [{float(jnp.min(res.policy_score)):.3f}, "
+        f"{float(jnp.max(res.policy_score)):.3f}]")
+
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        res = ev(params)
+        jax.block_until_ready(res.policy_score)
+        times.append(time.perf_counter() - t0)
+    best = min(times)
+    evals_per_sec = pop_size / best
+    log(f"steady-state: {best:.3f}s / {pop_size} evals "
+        f"(all reps: {[round(t, 3) for t in times]})")
+
+    print(json.dumps({
+        "metric": "candidate policy evaluations/sec (8152-pod trace)",
+        "value": round(evals_per_sec, 2),
+        "unit": "evals/s",
+        "vs_baseline": round(evals_per_sec / BASELINE_EVALS_PER_SEC, 3),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

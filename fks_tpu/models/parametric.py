@@ -44,7 +44,7 @@ FEATURE_NAMES = (
     "node_has_gpu",
     "best_fit",          # 1 - weighted normalized remaining (zoo best_fit core)
     "gpu_imbalance",     # (max - min free milli) / 1000
-    "headroom",          # 1 if node keeps 2x pod cpu+mem after placement
+    "headroom",          # 1 if node has > 2x the pod's cpu AND mem free
 )
 
 NUM_FEATURES = len(FEATURE_NAMES)

@@ -140,22 +140,25 @@ def make_sharded_generation_step(workload: Workload, mesh: Mesh,
     (funsearch_integration.py:487-572) minus the host-side LLM stage, which
     stays on CPU exactly as the reference keeps it outside its hot path.
 
-    Returns ``step(params[C,F], key) -> (new_params[C,F], scores[C],
-    elite_scores[K])``; both params arrays are sharded over ``pop``.
+    Returns ``step(params[C,F], key, real_count=None) -> (new_params[C,F],
+    scores[C], elite_scores[K])``; both params arrays are sharded over
+    ``pop``. Forward ``pad_population``'s ``real_count`` so pad duplicates
+    never win elite slots.
     """
     run = make_single_run(workload, param_policy, cfg)
     state0 = initial_state(workload, cfg)
 
     @functools.partial(
         jax.shard_map, mesh=mesh,
-        in_specs=(P(POP_AXIS), P()),
+        in_specs=(P(POP_AXIS), P(), P()),
         out_specs=(P(POP_AXIS), P(POP_AXIS), P()),
         check_vma=False,
     )
-    def gen_step(params_shard, key):
+    def gen_step(params_shard, key, real_count):
         local_scores, global_scores = _global_scores(run, state0, params_shard)
         all_params = jax.lax.all_gather(params_shard, POP_AXIS, tiled=True)
-        elite_scores, elite_idx = jax.lax.top_k(global_scores, elite_k)
+        elite_scores, elite_idx = jax.lax.top_k(
+            _mask_pad(global_scores, real_count), elite_k)
         elites = all_params[elite_idx]
 
         # Per-shard offspring: elites survive in shard 0's slots, the rest
@@ -171,7 +174,10 @@ def make_sharded_generation_step(workload: Workload, mesh: Mesh,
         new_shard = jnp.where(is_elite_slot[:, None], survivors, offspring)
         return new_shard, local_scores, elite_scores
 
-    def step(params, key):
-        return gen_step(_shard_params(params, mesh), key)
+    def step(params, key, real_count=None):
+        params = _shard_params(params, mesh)
+        if real_count is None:
+            real_count = params.shape[0]
+        return gen_step(params, key, jnp.asarray(real_count, jnp.int32))
 
     return jax.jit(step)
