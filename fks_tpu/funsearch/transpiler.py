@@ -1,0 +1,581 @@
+"""Restricted-Python -> vectorized JAX policy compiler.
+
+This is the TPU-native answer to the reference's sandboxed interpretation of
+evolved code: where the reference ``exec``s candidate source and calls the
+resulting scalar ``priority_function(pod, node)`` once per node per event
+(reference: funsearch/funsearch_integration.py:67-101,
+funsearch/safe_execution.py:126-168), here the SAME source is compiled once
+into a jit-traceable ``PolicyFn`` that scores ALL nodes in one fused vector
+program — so evolved candidates run inside the device event loop at zoo-policy
+speed, with no Python in the hot path.
+
+Lowering rules (SURVEY.md §7 "dynamic policy code on device"):
+- every value is (broadcastable to) an array over the node axis N;
+- ``if``/``elif``/``else`` -> both branches execute, assignments blend under
+  the branch predicate (``jnp.where``) — classic predication;
+- ``return`` -> a per-lane ``returned`` mask + first-return-wins value blend;
+- ``for gpu in node.gpus`` -> a static unrolled loop over the padded GPU
+  axis G, body masked by ``gpu_mask[:, g]`` (real-GPU lanes only);
+- ``a and b`` / ``a or b`` keep Python value semantics
+  (``where(truthy(a), b, a)`` / ``where(truthy(a), a, b)``);
+- ``int(x)`` truncates toward zero like Python; ``//``/``%`` follow Python
+  sign semantics (numpy matches for these);
+- the final result is truncated to int32 — the engine's score contract.
+
+Divergence from the reference, by design: arithmetic faults (division by
+zero, log of a negative) do not raise — lanes whose score comes out
+non-finite score 0 (refuse) instead of aborting the whole candidate. The
+reference maps such candidates to fitness 0 via the exception path
+(funsearch_integration.py:63-64); here they merely refuse the affected
+nodes. The prompt instructs guarded division, and differential tests only
+use guarded candidates.
+"""
+from __future__ import annotations
+
+import ast
+import math
+from typing import Any, Dict, Optional
+
+import jax.numpy as jnp
+
+from fks_tpu.funsearch import sandbox
+from fks_tpu.sim.types import NodeView, PodView, PolicyFn
+
+
+class TranspileError(ValueError):
+    """Candidate uses syntax outside the JAX-lowerable subset."""
+
+
+# ------------------------------------------------------------ object model
+
+class _Pod:
+    """Scalar pod fields (broadcast over N by jnp)."""
+
+    FIELDS = ("cpu_milli", "memory_mib", "num_gpu", "gpu_milli",
+              "creation_time", "duration_time")
+
+    def __init__(self, pod: PodView):
+        self._pod = pod
+
+    def attr(self, name: str):
+        if name not in self.FIELDS:
+            raise TranspileError(f"unknown pod attribute {name!r}")
+        return getattr(self._pod, name)
+
+
+class _GpuList:
+    """``node.gpus`` — iteration yields one padded-GPU column at a time."""
+
+    def __init__(self, nodes: NodeView):
+        self.nodes = nodes
+
+    @property
+    def count(self):
+        return self.nodes.num_gpus  # i32[N] == len(node.gpus) per node
+
+    @property
+    def padded(self) -> int:
+        return self.nodes.gpu_mask.shape[1]
+
+
+class _Gpu:
+    """One column g of the per-GPU arrays. ``memory_mib_left`` maps to the
+    static total: the reference never allocates GPU memory
+    (SURVEY.md §2 fine print 11)."""
+
+    def __init__(self, nodes: NodeView, g: int):
+        self.nodes, self.g = nodes, g
+
+    def attr(self, name: str):
+        n, g = self.nodes, self.g
+        if name == "gpu_milli_left":
+            return n.gpu_milli_left[:, g]
+        if name == "gpu_milli_total":
+            return n.gpu_milli_total[:, g]
+        if name in ("memory_mib_left", "memory_mib_total"):
+            return n.gpu_mem_total[:, g]
+        raise TranspileError(f"unknown gpu attribute {name!r}")
+
+
+class _Node:
+    FIELDS = ("cpu_milli_left", "cpu_milli_total", "memory_mib_left",
+              "memory_mib_total", "gpu_left")
+
+    def __init__(self, nodes: NodeView):
+        self._nodes = nodes
+        self.gpus = _GpuList(nodes)
+
+    def attr(self, name: str):
+        if name == "gpus":
+            return self.gpus
+        if name not in self.FIELDS:
+            raise TranspileError(f"unknown node attribute {name!r}")
+        return getattr(self._nodes, name)
+
+
+_MATH_FNS = {
+    "sqrt": jnp.sqrt, "log": jnp.log, "exp": jnp.exp, "pow": jnp.power,
+    "sin": jnp.sin, "cos": jnp.cos, "tan": jnp.tan,
+}
+
+
+def _truthy(v):
+    if isinstance(v, bool):
+        return v
+    a = jnp.asarray(v)
+    return a if a.dtype == jnp.bool_ else a != 0
+
+
+def _int_trunc(v):
+    """Python int(): truncate toward zero. Non-finite inputs (where Python
+    raises OverflowError/ValueError and the reference maps the candidate to
+    fitness 0) become 0 — the lane refuses (module docstring divergence)."""
+    a = jnp.asarray(v)
+    if jnp.issubdtype(a.dtype, jnp.integer):
+        return a
+    if a.dtype == bool:
+        return a.astype(jnp.int32)
+    return jnp.where(jnp.isfinite(a), jnp.trunc(a), 0).astype(jnp.int32)
+
+
+def _where(mask, new, old):
+    return jnp.where(mask, new, old)
+
+
+class _Interp:
+    """Vectorized symbolic executor over the function AST.
+
+    ``mask`` threading: each block executes under an "active lanes" bool[N];
+    assignments and returns only take effect on active lanes. ``returned``
+    is global (a return deactivates the lane for the rest of the function,
+    including subsequent loop iterations).
+    """
+
+    MAX_UNROLL = 64  # static range() loops larger than this are rejected
+
+    def __init__(self, pod: PodView, nodes: NodeView):
+        self.n = nodes.node_mask.shape[0]
+        self.env: Dict[str, Any] = {
+            "pod": _Pod(pod), "node": _Node(nodes), "math": "math",
+        }
+        self.nodes = nodes
+        self.returned = jnp.zeros(self.n, bool)
+        self.retval = jnp.zeros(self.n, jnp.int32)
+        # lanes where Python would have raised (int() of a non-finite);
+        # they refuse at the end instead of aborting the candidate
+        self.poison = jnp.zeros(self.n, bool)
+
+    # ----- statements
+
+    def run_block(self, stmts, mask):
+        for st in stmts:
+            self.run_stmt(st, mask & ~self.returned)
+
+    def run_stmt(self, st, mask):
+        if isinstance(st, ast.Assign):
+            if len(st.targets) != 1 or not isinstance(st.targets[0], ast.Name):
+                raise TranspileError("only simple `name = expr` assignment")
+            self.assign(st.targets[0].id, self.eval(st.value, mask), mask)
+        elif isinstance(st, ast.AugAssign):
+            if not isinstance(st.target, ast.Name):
+                raise TranspileError("only simple augmented assignment")
+            cur = self.load(st.target.id)
+            val = self.binop(st.op, cur, self.eval(st.value, mask))
+            self.assign(st.target.id, val, mask)
+        elif isinstance(st, ast.If):
+            cond = _truthy(self.eval(st.test, mask))
+            self.run_block(st.body, mask & cond)
+            if st.orelse:
+                self.run_block(st.orelse, mask & ~cond)
+        elif isinstance(st, ast.Return):
+            if st.value is None:
+                raise TranspileError("bare return not allowed")
+            val = self.eval(st.value, mask)
+            active = mask & ~self.returned
+            self.retval = _where(active, val, self.retval)
+            self.returned = self.returned | active
+        elif isinstance(st, ast.For):
+            self.run_for(st, mask)
+        elif isinstance(st, ast.Expr):
+            if isinstance(st.value, ast.Constant):  # docstring
+                return
+            raise TranspileError("expression statements have no effect")
+        elif isinstance(st, ast.Pass):
+            return
+        else:
+            raise TranspileError(f"unsupported statement {type(st).__name__}")
+
+    def run_for(self, st, mask):
+        if st.orelse:
+            raise TranspileError("for/else not supported")
+        it = self.eval_iter(st.iter, mask)
+        if isinstance(it, _GpuList):
+            if not isinstance(st.target, ast.Name):
+                raise TranspileError("gpu loop target must be a name")
+            for g in range(it.padded):
+                gmask = mask & self.nodes.gpu_mask[:, g] & ~self.returned
+                self.env[st.target.id] = _Gpu(self.nodes, g)
+                self.run_block(st.body, gmask)
+            self.env.pop(st.target.id, None)
+        elif isinstance(it, _EnumGpus):
+            if not (isinstance(st.target, ast.Tuple)
+                    and len(st.target.elts) == 2
+                    and all(isinstance(e, ast.Name) for e in st.target.elts)):
+                raise TranspileError("enumerate target must be `i, gpu`")
+            iname, gname = (e.id for e in st.target.elts)
+            for g in range(it.gpus.padded):
+                gmask = mask & self.nodes.gpu_mask[:, g] & ~self.returned
+                self.env[iname] = g
+                self.env[gname] = _Gpu(self.nodes, g)
+                self.run_block(st.body, gmask)
+            self.env.pop(iname, None)
+            self.env.pop(gname, None)
+        elif isinstance(it, range):
+            if not isinstance(st.target, ast.Name):
+                raise TranspileError("range loop target must be a name")
+            if len(it) > self.MAX_UNROLL:
+                raise TranspileError(f"range loop longer than {self.MAX_UNROLL}")
+            for i in it:
+                self.env[st.target.id] = i
+                self.run_block(st.body, mask & ~self.returned)
+            self.env.pop(st.target.id, None)
+        else:
+            raise TranspileError(
+                "only `for gpu in node.gpus`, enumerate(node.gpus), or "
+                "constant range() loops are supported")
+
+    def eval_iter(self, node, mask):
+        if (isinstance(node, ast.Call) and isinstance(node.func, ast.Name)):
+            if node.func.id == "range":
+                args = [self.eval(a, mask) for a in node.args]
+                if not all(isinstance(a, int) for a in args):
+                    raise TranspileError("range() bounds must be static ints")
+                return range(*args)
+            if node.func.id == "enumerate":
+                inner = self.eval(node.args[0], mask)
+                if isinstance(inner, _GpuList):
+                    return _EnumGpus(inner)
+                raise TranspileError("enumerate() only over node.gpus")
+        return self.eval(node, mask)
+
+    # ----- environment
+
+    def assign(self, name: str, val, mask):
+        if name in ("pod", "node", "math"):
+            raise TranspileError(f"cannot rebind {name!r}")
+        if isinstance(val, (_Pod, _Node, _Gpu, _GpuList, _EnumGpus)):
+            raise TranspileError("cannot store entity objects in variables")
+        active = mask & ~self.returned
+        all_active = _statically_true(active)
+        if name in self.env:
+            old = self.env[name]
+            if isinstance(old, (int, float)) and isinstance(val, (int, float)) \
+                    and all_active:
+                self.env[name] = val  # stay scalar on unconditional paths
+            else:
+                self.env[name] = _where(active, val, old)
+        else:
+            if isinstance(val, (int, float)) and all_active:
+                self.env[name] = val
+            else:
+                # first assignment under a condition: other lanes see 0,
+                # mirroring "NameError on the untaken path" as a refusal
+                self.env[name] = _where(active, val, 0)
+
+    def load(self, name: str):
+        if name not in self.env:
+            raise TranspileError(f"undefined variable {name!r}")
+        return self.env[name]
+
+    # ----- expressions
+
+    def eval(self, node, mask):
+        if isinstance(node, ast.Constant):
+            if isinstance(node.value, bool) or isinstance(node.value, (int, float)):
+                return node.value
+            raise TranspileError(f"unsupported constant {node.value!r}")
+        if isinstance(node, ast.Name):
+            return self.load(node.id)
+        if isinstance(node, ast.Attribute):
+            base = self.eval(node.value, mask)
+            if isinstance(base, _Pod) or isinstance(base, _Node) \
+                    or isinstance(base, _Gpu):
+                return base.attr(node.attr)
+            raise TranspileError(
+                f"attribute access on non-entity value: .{node.attr}")
+        if isinstance(node, ast.BinOp):
+            return self.binop(node.op, self.eval(node.left, mask),
+                              self.eval(node.right, mask))
+        if isinstance(node, ast.UnaryOp):
+            v = self.eval(node.operand, mask)
+            if isinstance(node.op, ast.USub):
+                return -v if isinstance(v, (int, float)) else jnp.negative(v)
+            if isinstance(node.op, ast.UAdd):
+                return v
+            if isinstance(node.op, ast.Not):
+                t = _truthy(v)
+                return (not t) if isinstance(t, bool) else jnp.logical_not(t)
+            raise TranspileError("unsupported unary operator")
+        if isinstance(node, ast.BoolOp):
+            vals = [self.eval(v, mask) for v in node.values]
+            out = vals[0]
+            for v in vals[1:]:
+                t = _truthy(out)
+                if isinstance(t, bool):
+                    out = (v if t else out) if isinstance(node.op, ast.And) \
+                        else (out if t else v)
+                elif isinstance(node.op, ast.And):
+                    out = _where(t, v, out)
+                else:
+                    out = _where(t, out, v)
+            return out
+        if isinstance(node, ast.Compare):
+            left = self.eval(node.left, mask)
+            result = None
+            for op, rhs_node in zip(node.ops, node.comparators):
+                rhs = self.eval(rhs_node, mask)
+                c = self.compare(op, left, rhs)
+                result = c if result is None else jnp.logical_and(result, c)
+                left = rhs
+            return result
+        if isinstance(node, ast.IfExp):
+            cond = _truthy(self.eval(node.test, mask))
+            a = self.eval(node.body, mask)
+            b = self.eval(node.orelse, mask)
+            if isinstance(cond, bool):
+                return a if cond else b
+            return _where(cond, a, b)
+        if isinstance(node, ast.Call):
+            return self.call(node, mask)
+        raise TranspileError(f"unsupported expression {type(node).__name__}")
+
+    def binop(self, op, a, b):
+        both_py = isinstance(a, (int, float)) and isinstance(b, (int, float))
+        if isinstance(op, ast.Add):
+            return a + b
+        if isinstance(op, ast.Sub):
+            return a - b
+        if isinstance(op, ast.Mult):
+            return a * b
+        if isinstance(op, ast.Div):
+            if both_py:
+                return a / b if b != 0 else math.inf  # lowered to refuse later
+            return jnp.asarray(a) / jnp.asarray(b)
+        if isinstance(op, ast.FloorDiv):
+            if both_py:
+                return a // b if b != 0 else math.inf
+            return jnp.floor_divide(jnp.asarray(a), jnp.asarray(b))
+        if isinstance(op, ast.Mod):
+            if both_py:
+                return a % b if b != 0 else math.inf
+            return jnp.mod(jnp.asarray(a), jnp.asarray(b))
+        if isinstance(op, ast.Pow):
+            if both_py:
+                try:
+                    return a ** b
+                except (OverflowError, ZeroDivisionError):
+                    return math.inf
+            return jnp.power(a, b)
+        raise TranspileError("unsupported binary operator")
+
+    def compare(self, op, a, b):
+        if isinstance(op, ast.Eq):
+            return jnp.equal(a, b) if not _is_py(a, b) else a == b
+        if isinstance(op, ast.NotEq):
+            return jnp.not_equal(a, b) if not _is_py(a, b) else a != b
+        if isinstance(op, ast.Lt):
+            return jnp.less(a, b) if not _is_py(a, b) else a < b
+        if isinstance(op, ast.LtE):
+            return jnp.less_equal(a, b) if not _is_py(a, b) else a <= b
+        if isinstance(op, ast.Gt):
+            return jnp.greater(a, b) if not _is_py(a, b) else a > b
+        if isinstance(op, ast.GtE):
+            return jnp.greater_equal(a, b) if not _is_py(a, b) else a >= b
+        raise TranspileError("unsupported comparison")
+
+    def call(self, node, mask):
+        if node.keywords:
+            raise TranspileError("keyword arguments not supported")
+        f = node.func
+        if isinstance(f, ast.Attribute):
+            if isinstance(f.value, ast.Name) and f.value.id == "math" \
+                    and f.attr in _MATH_FNS:
+                args = [self.eval(a, mask) for a in node.args]
+                return _MATH_FNS[f.attr](*args)
+            raise TranspileError("only math.<fn> attribute calls allowed")
+        if not isinstance(f, ast.Name):
+            raise TranspileError("computed call targets not allowed")
+        name = f.id
+
+        # reductions over a generator comprehension
+        if name in ("sum", "min", "max") and len(node.args) == 1 \
+                and isinstance(node.args[0], ast.GeneratorExp):
+            return self.reduce_genexp(name, node.args[0], mask)
+
+        args = [self.eval(a, mask) for a in node.args]
+        if name == "abs":
+            (a,) = args
+            return abs(a) if isinstance(a, (int, float)) else jnp.abs(a)
+        if name in ("min", "max"):
+            if len(args) < 2:
+                raise TranspileError(f"{name}() needs 2+ args or a generator")
+            fn = jnp.minimum if name == "min" else jnp.maximum
+            py = min if name == "min" else max
+            out = args[0]
+            for a in args[1:]:
+                out = py(out, a) if _is_py(out, a) else fn(out, a)
+            return out
+        if name == "len":
+            (a,) = args
+            if isinstance(a, _GpuList):
+                return a.count
+            raise TranspileError("len() only of node.gpus")
+        if name == "int":
+            (a,) = args
+            if isinstance(a, (int, float)):
+                if not math.isfinite(a):
+                    self.poison = self.poison | mask
+                    return 0
+                return int(a)
+            arr = jnp.asarray(a)
+            if jnp.issubdtype(arr.dtype, jnp.floating):
+                self.poison = self.poison | (mask & ~jnp.isfinite(arr))
+            return _int_trunc(a)
+        if name == "float":
+            (a,) = args
+            return float(a) if isinstance(a, (int, float)) \
+                else jnp.asarray(a).astype(jnp.float64 if _x64() else jnp.float32)
+        if name == "bool":
+            (a,) = args
+            return _truthy(a)
+        if name == "round":
+            args2 = args if len(args) == 2 else (args[0],)
+            if all(isinstance(a, (int, float)) for a in args2):
+                return round(*args2)
+            if len(args2) == 2:
+                if not isinstance(args2[1], int):
+                    raise TranspileError("round() digits must be static")
+                s = 10 ** args2[1]
+                return jnp.round(jnp.asarray(args2[0]) * s) / s
+            return jnp.round(jnp.asarray(args2[0]))
+        if name == "sum":
+            raise TranspileError("sum() only over a generator")
+        raise TranspileError(f"call to unsupported function {name!r}")
+
+    def reduce_genexp(self, name, gen, mask):
+        """``sum/min/max(expr for gpu in node.gpus [if cond])`` -> masked
+        reduction over the padded GPU axis."""
+        if len(gen.generators) != 1:
+            raise TranspileError("single-clause generators only")
+        comp = gen.generators[0]
+        if comp.is_async:
+            raise TranspileError("async generators not allowed")
+        it = self.eval_iter(comp.iter, mask)
+        if not isinstance(it, _GpuList):
+            raise TranspileError("generators only over node.gpus")
+        if not isinstance(comp.target, ast.Name):
+            raise TranspileError("generator target must be a name")
+        tname = comp.target.id
+        saved = self.env.get(tname)
+        cols, conds = [], []
+        for g in range(it.padded):
+            self.env[tname] = _Gpu(self.nodes, g)
+            sel = self.nodes.gpu_mask[:, g]
+            for if_ in comp.ifs:
+                sel = sel & _truthy(self.eval(if_, mask))
+            cols.append(jnp.asarray(self.eval(gen.elt, mask)))
+            conds.append(sel)
+        if saved is None:
+            self.env.pop(tname, None)
+        else:
+            self.env[tname] = saved
+        vals = jnp.stack([jnp.broadcast_to(c, (self.n,)) for c in cols], axis=1)
+        sel = jnp.stack(conds, axis=1)
+        if name == "sum":
+            return jnp.sum(jnp.where(sel, vals, 0), axis=1)
+        if jnp.issubdtype(vals.dtype, jnp.integer):
+            info = jnp.iinfo(vals.dtype)
+            big = info.max if name == "min" else info.min
+        else:
+            big = jnp.inf if name == "min" else -jnp.inf
+        out = jnp.where(sel, vals, jnp.asarray(big, vals.dtype))
+        return jnp.min(out, axis=1) if name == "min" else jnp.max(out, axis=1)
+
+
+class _EnumGpus:
+    def __init__(self, gpus: _GpuList):
+        self.gpus = gpus
+
+
+def _is_py(*vals):
+    return all(isinstance(v, (int, float, bool)) for v in vals)
+
+
+def _statically_true(mask) -> bool:
+    """True iff ``mask`` is a compile-time constant that is all-True (safe
+    under jit: tracers — data-dependent masks — report False)."""
+    import jax
+    if isinstance(mask, jax.core.Tracer):
+        return False
+    try:
+        return bool(jnp.all(mask))
+    except Exception:
+        return False
+
+
+def _x64() -> bool:
+    return jnp.zeros(0).dtype == jnp.float64
+
+
+# --------------------------------------------------------------- public API
+
+def canonical_key(code: str) -> str:
+    """Compile-cache key: the AST dump, insensitive to comments/whitespace
+    (SURVEY.md §7: dedup doubles as compile-cache key)."""
+    return ast.dump(ast.parse(code))
+
+
+def transpile(code: str, entry_point: str = "priority_function") -> PolicyFn:
+    """Validate + compile candidate source into a vectorized PolicyFn.
+
+    Raises ``TranspileError`` for code outside the lowerable subset (this is
+    the TPU-tightened third validation stage, SURVEY.md §2 fine print 10).
+    """
+    r = sandbox.validate(code, entry_point)
+    if not r:
+        raise TranspileError(f"validation failed: {r.reason}")
+    tree = ast.parse(code)
+    fn = next(n for n in tree.body if isinstance(n, ast.FunctionDef))
+    body = fn.body
+
+    def policy(pod: PodView, nodes: NodeView):
+        interp = _Interp(pod, nodes)
+        interp.run_block(body, jnp.ones(interp.n, bool))
+        val = interp.retval
+        # lanes that never returned, or whose arithmetic went non-finite,
+        # refuse (see module docstring divergence note)
+        vf = jnp.asarray(val)
+        if not jnp.issubdtype(vf.dtype, jnp.integer):
+            finite = jnp.isfinite(vf)
+            vf = jnp.where(finite, vf, 0)
+        out = _int_trunc(vf).astype(jnp.int32)
+        return jnp.where(interp.returned & ~interp.poison, out, 0)
+
+    _dry_trace(policy)
+    return policy
+
+
+def _dry_trace(policy: PolicyFn) -> None:
+    """Abstractly evaluate the lowered policy on tiny dummy views so subset
+    violations (unsupported calls, oversized unrolls, unknown attributes)
+    surface at transpile time, not at first simulation."""
+    import jax
+
+    n, g = 2, 2
+    i = jnp.zeros((), jnp.int32)
+    pod = PodView(i, i, i, i, i, i)
+    vn = jnp.zeros(n, jnp.int32)
+    vg = jnp.zeros((n, g), jnp.int32)
+    nodes = NodeView(vn, vn, vn, vn, vn, vn, vg, vg, vg,
+                     jnp.ones((n, g), bool), jnp.ones(n, bool))
+    jax.eval_shape(policy, pod, nodes)

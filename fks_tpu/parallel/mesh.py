@@ -83,6 +83,18 @@ def _mask_pad(scores, real_count):
     return jnp.where(iota < real_count, scores, -jnp.inf)
 
 
+def _top_k_real(global_scores, real_count, k):
+    """top_k that never surfaces a pad slot: when real_count < k the
+    trailing slots repeat the best real candidate instead of returning a
+    -inf pad entry (which would otherwise survive truncation and be
+    sampled as a mutation parent)."""
+    elite_scores, elite_idx = jax.lax.top_k(
+        _mask_pad(global_scores, real_count), k)
+    valid = jnp.isfinite(elite_scores)
+    return (jnp.where(valid, elite_scores, elite_scores[0]),
+            jnp.where(valid, elite_idx, elite_idx[0]))
+
+
 # NOTE on check_vma=False: the engine's inner heap loops mix invariant
 # literals into varying carries; the varying-manual-axes audit rejects that
 # even though the program is correct. Correctness of the sharded path is
@@ -115,8 +127,7 @@ def make_sharded_eval(workload: Workload, mesh: Mesh,
     )
     def shard_eval(params_shard, real_count):
         local_scores, global_scores = _global_scores(run, state0, params_shard)
-        elite_scores, elite_idx = jax.lax.top_k(
-            _mask_pad(global_scores, real_count), elite_k)
+        elite_scores, elite_idx = _top_k_real(global_scores, real_count, elite_k)
         return local_scores, elite_idx, elite_scores
 
     def sharded_eval(params, real_count=None):
@@ -157,8 +168,7 @@ def make_sharded_generation_step(workload: Workload, mesh: Mesh,
     def gen_step(params_shard, key, real_count):
         local_scores, global_scores = _global_scores(run, state0, params_shard)
         all_params = jax.lax.all_gather(params_shard, POP_AXIS, tiled=True)
-        elite_scores, elite_idx = jax.lax.top_k(
-            _mask_pad(global_scores, real_count), elite_k)
+        elite_scores, elite_idx = _top_k_real(global_scores, real_count, elite_k)
         elites = all_params[elite_idx]
 
         # Per-shard offspring: elites survive in shard 0's slots, the rest

@@ -1,0 +1,78 @@
+"""Sandbox validation semantics (reference: funsearch/safe_execution.py
+SafeExecutor behavior — accept restricted math policies, reject escapes)."""
+import pytest
+
+from fks_tpu.funsearch import sandbox, template
+
+GOOD = template.fill_template("score = 100 + pod.cpu_milli / max(1, node.cpu_milli_left)")
+
+
+def test_accepts_good_policy():
+    assert sandbox.validate(GOOD)
+
+
+def test_seed_policies_validate_and_run():
+    for name, code in template.seed_policies().items():
+        assert sandbox.validate(code), name
+        assert sandbox.smoke_test(code) is None, name
+
+
+@pytest.mark.parametrize("bad", [
+    "import os",
+    "score = __builtins__",
+    "score = eval('1')",
+    "score = exec('x = 1')",
+    "score = open('/etc/passwd')",
+    "score = getattr(pod, 'cpu_milli')",
+    "score = (lambda: 1)()",
+    "while True:\n        score = 1",
+])
+def test_rejects_escapes(bad):
+    code = template.fill_template(bad)
+    assert not sandbox.validate(code)
+
+
+def test_rejects_wrong_signature():
+    assert not sandbox.validate("def priority_function(a, b):\n    return 1")
+    assert not sandbox.validate("def other(pod, node):\n    return 1")
+    assert not sandbox.validate(
+        "def priority_function(pod, node):\n    return 1\nx = 2")
+
+
+def test_rejects_non_whitelisted_call():
+    code = template.fill_template("score = print(1)")
+    r = sandbox.validate(code)
+    assert not r and "print" in r.reason
+
+
+def test_rejects_syntax_error():
+    assert not sandbox.validate("def priority_function(pod, node:\n    return 1")
+
+
+def test_scalar_execution_matches_hand_math():
+    pod = sandbox.ScalarPod(cpu_milli=1000, memory_mib=2048, num_gpu=1,
+                            gpu_milli=300)
+    node = sandbox.ScalarNode(
+        cpu_milli_left=5000, cpu_milli_total=8000,
+        memory_mib_left=9000, memory_mib_total=16000, gpu_left=2,
+        gpus=(sandbox.ScalarGPU(700, 1000), sandbox.ScalarGPU(200, 1000)))
+    code = template.fill_template(
+        "score = node.cpu_milli_left - pod.cpu_milli")
+    # feasible (gpu0 fits 300): score = max(1, int(4000)) = 4000
+    assert sandbox.execute_scalar(code, pod, node) == 4000.0
+
+
+def test_scalar_execution_infeasible_returns_zero():
+    pod = sandbox.ScalarPod(cpu_milli=99999, memory_mib=1, num_gpu=0, gpu_milli=0)
+    node = sandbox.ScalarNode(1000, 1000, 1000, 1000, 0, ())
+    assert sandbox.execute_scalar(GOOD, pod, node) == 0.0
+
+
+def test_runtime_error_raises_policy_error():
+    code = template.fill_template("score = 1 / (pod.num_gpu - pod.num_gpu)")
+    pod = sandbox.ScalarPod(1, 1, 0, 0)
+    node = sandbox.ScalarNode(1000, 1000, 1000, 1000, 0, ())
+    # prologue passes (num_gpu=0): division by zero must surface as
+    # PolicyRuntimeError, not crash the process
+    with pytest.raises(sandbox.PolicyRuntimeError):
+        sandbox.execute_scalar(code, pod, node)
