@@ -1,0 +1,25 @@
+"""FunSearch evolution layer: sandbox, transpiler, codegen, controller.
+
+TPU-native counterpart of the reference ``funsearch/`` package
+(reference: funsearch/safe_execution.py + funsearch/funsearch_integration.py).
+"""
+from fks_tpu.funsearch.backend import CodeEvaluator, EvalRecord
+from fks_tpu.funsearch.evolution import (
+    EvolutionConfig, FunSearch, GenerationStats, LLMSettings, run,
+)
+from fks_tpu.funsearch.llm import (
+    CandidateGenerator, FakeLLM, OpenAIBackend, generate_many,
+)
+from fks_tpu.funsearch.sandbox import (
+    ScalarGPU, ScalarNode, ScalarPod, execute_scalar, smoke_test, validate,
+)
+from fks_tpu.funsearch.template import build_prompt, fill_template, seed_policies
+from fks_tpu.funsearch.transpiler import TranspileError, canonical_key, transpile
+
+__all__ = [
+    "CandidateGenerator", "CodeEvaluator", "EvalRecord", "EvolutionConfig",
+    "FakeLLM", "FunSearch", "GenerationStats", "LLMSettings", "OpenAIBackend",
+    "ScalarGPU", "ScalarNode", "ScalarPod", "TranspileError", "build_prompt",
+    "canonical_key", "execute_scalar", "fill_template", "generate_many",
+    "run", "seed_policies", "smoke_test", "transpile", "validate",
+]

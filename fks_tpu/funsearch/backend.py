@@ -1,0 +1,112 @@
+"""Fitness backend for code candidates: transpile -> jit -> evaluate.
+
+TPU-native replacement for the reference's subprocess fitness fan-out
+(reference: funsearch/funsearch_integration.py:30-64 ``evaluate_policy_
+standalone`` + 535-562 ProcessPoolExecutor): instead of forking a process
+per candidate that re-parses the trace CSVs and runs the Python event loop,
+each unique candidate is transpiled once into a vectorized policy, jitted
+against the device-resident workload, and executed on-chip. The trace is
+parsed once for the life of the backend; repeated/near-identical candidates
+hit an AST-keyed compile cache (SURVEY.md §7: dedup doubles as compile-cache
+key).
+
+Failure semantics follow the reference's subprocess path: any failure —
+validation, transpile, or execution — maps to fitness 0.0 and the candidate
+stays in the pool's view (reference: funsearch_integration.py:63-64;
+SURVEY.md §2 fine print 8).
+
+Two throughput tiers:
+- code candidates: one compiled program per unique AST (this module);
+- parametric candidates: one program TOTAL for the whole population
+  (fks_tpu.parallel.population / .mesh) — the fast path the evolution
+  controller uses for weight-vector mutation between LLM rounds.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import numpy as np
+
+from fks_tpu.data.entities import Workload
+from fks_tpu.funsearch import transpiler
+from fks_tpu.sim.engine import SimConfig, initial_state, make_run_fn
+from fks_tpu.sim.types import SimResult
+
+
+@dataclasses.dataclass
+class EvalRecord:
+    """One candidate's evaluation outcome."""
+
+    code: str
+    score: float
+    error: Optional[str] = None  # why fitness is 0, when it is
+    result: Optional[SimResult] = None
+
+    @property
+    def ok(self) -> bool:
+        return self.error is None
+
+
+class CodeEvaluator:
+    """Evaluate candidate source strings against one workload.
+
+    The compile cache maps canonical AST keys to jitted run functions, so a
+    re-submitted (or whitespace-variant) candidate costs one device launch,
+    not a retrace. XLA's own jit cache adds a second layer keyed on the
+    traced computation.
+    """
+
+    def __init__(self, workload: Workload, cfg: SimConfig = SimConfig()):
+        self.workload = workload
+        self.cfg = cfg
+        self.state0 = initial_state(workload, cfg)
+        self._cache: Dict[str, object] = {}
+        self.compile_count = 0  # observability: unique programs built
+
+    def _compiled(self, code: str):
+        key = transpiler.canonical_key(code)
+        fn = self._cache.get(key)
+        if fn is None:
+            policy = transpiler.transpile(code)
+            fn = jax.jit(make_run_fn(self.workload, policy, self.cfg))
+            self._cache[key] = fn
+            self.compile_count += 1
+        return fn
+
+    def evaluate_one(self, code: str) -> EvalRecord:
+        """Reference semantics: exceptions -> score 0 with the reason kept
+        (the reference loses the reason; we keep it for observability)."""
+        try:
+            run = self._compiled(code)
+            result: SimResult = run(self.state0)
+            score = float(result.policy_score)
+            if bool(result.failed):
+                return EvalRecord(code, 0.0, "gpu allocation aborted", result)
+            if bool(result.truncated):
+                return EvalRecord(code, 0.0, "event budget exceeded", result)
+            return EvalRecord(code, score, None, result)
+        except transpiler.TranspileError as e:
+            return EvalRecord(code, 0.0, f"transpile: {e}")
+        except Exception as e:  # noqa: BLE001 — candidate code is untrusted
+            return EvalRecord(code, 0.0, f"runtime: {e}")
+
+    def evaluate(self, codes: Sequence[str]) -> List[EvalRecord]:
+        """Evaluate a batch; duplicate sources are computed once."""
+        memo: Dict[str, EvalRecord] = {}
+        out = []
+        for code in codes:
+            try:
+                key = transpiler.canonical_key(code)
+            except SyntaxError as e:
+                out.append(EvalRecord(code, 0.0, f"syntax: {e}"))
+                continue
+            if key not in memo:
+                memo[key] = self.evaluate_one(code)
+            r = memo[key]
+            out.append(EvalRecord(code, r.score, r.error, r.result))
+        return out
+
+    def scores(self, codes: Sequence[str]) -> np.ndarray:
+        return np.asarray([r.score for r in self.evaluate(codes)], np.float64)

@@ -1,0 +1,162 @@
+"""LLM code generation: OpenAI-compatible client + hermetic fake backend.
+
+Counterpart of the reference generator (reference:
+funsearch/safe_execution.py:273-328 ``LLMCodeGenerator`` — an OpenAI-SDK
+chat.completions call against OpenRouter, template fill, validate, None on
+any failure) and its thread-pool fan-out (reference:
+funsearch/funsearch_integration.py:461-525). Codegen is host-side I/O and
+stays off the device exactly as the reference keeps it outside its hot path
+(SURVEY.md §3.2); concurrency is a ThreadPoolExecutor because the work is
+network-bound.
+
+The ``FakeLLM`` backend closes a testability gap called out in SURVEY.md §4:
+the reference has no fake LLM, so its evolution loop is untestable without a
+live API key. Here the fake draws deterministic mutations from a small
+grammar of scoring ideas, seeded per call, so evolution tests are hermetic
+and reproducible.
+"""
+from __future__ import annotations
+
+import concurrent.futures
+import random
+import threading
+from typing import Callable, List, Optional, Protocol, Sequence, Tuple
+
+from fks_tpu.funsearch import sandbox, template, transpiler
+
+Parent = Tuple[str, float]  # (candidate source, fitness)
+
+
+class TextBackend(Protocol):
+    """Something that turns a prompt into a raw logic block."""
+
+    def complete(self, prompt: str) -> str: ...
+
+
+class OpenAIBackend:
+    """Thin OpenAI-SDK adapter (reference: safe_execution.py:283-303).
+
+    The ``openai`` import is deferred and optional: environments without the
+    SDK (or without network egress) use ``FakeLLM``.
+    """
+
+    def __init__(self, api_key: str, base_url: str, model: str,
+                 max_tokens: int = 500, temperature: float = 0.7):
+        try:
+            import openai  # noqa: PLC0415 — optional dependency
+        except ImportError as e:  # pragma: no cover - image always has it
+            raise RuntimeError(
+                "openai SDK unavailable; use FakeLLM for hermetic runs") from e
+        self._client = openai.OpenAI(api_key=api_key, base_url=base_url)
+        self.model = model
+        self.max_tokens = max_tokens
+        self.temperature = temperature
+
+    def complete(self, prompt: str) -> str:
+        resp = self._client.chat.completions.create(
+            model=self.model,
+            messages=[{"role": "user", "content": prompt}],
+            max_tokens=self.max_tokens,
+            temperature=self.temperature,
+        )
+        return (resp.choices[0].message.content or "").strip()
+
+
+class FakeLLM:
+    """Deterministic offline "LLM": emits logic blocks from a grammar of
+    scheduling heuristics (packing pressure, fragmentation avoidance,
+    balance, GPU tightness), occasionally emitting junk to exercise the
+    validate/reject path the way real LLM output does."""
+
+    _TERMS = (
+        "(node.cpu_milli_left - pod.cpu_milli) / max(1, node.cpu_milli_total)",
+        "(node.memory_mib_left - pod.memory_mib) / max(1, node.memory_mib_total)",
+        "(node.gpu_left - pod.num_gpu) / max(1, len(node.gpus))",
+        "node.cpu_milli_left / max(1, node.cpu_milli_total)",
+        "node.memory_mib_left / max(1, node.memory_mib_total)",
+        "sum(gpu.gpu_milli_left for gpu in node.gpus) / max(1, 1000 * len(node.gpus))",
+        "sum(1 for gpu in node.gpus if gpu.gpu_milli_left >= pod.gpu_milli)"
+        " / max(1, len(node.gpus))",
+    )
+    _JUNK = (
+        "score = untrusted_helper(pod)",
+        "import os\n    score = 1",
+        "while node.gpu_left > 0:\n        score = 1",
+    )
+
+    def __init__(self, seed: int = 0, junk_rate: float = 0.1):
+        self._rng = random.Random(seed)
+        self._junk_rate = junk_rate
+        self._lock = threading.Lock()
+
+    def complete(self, prompt: str) -> str:  # noqa: ARG002 — prompt unused
+        with self._lock:
+            rng = self._rng
+            if rng.random() < self._junk_rate:
+                return rng.choice(self._JUNK)
+            n = rng.randint(1, 3)
+            terms = rng.sample(self._TERMS, n)
+            coeffs = [round(rng.uniform(-2.0, 2.0), 3) for _ in terms]
+            expr = " + ".join(f"({c}) * ({t})" for c, t in zip(coeffs, terms))
+            lines = [f"score = 10000 * (1.0 + {expr})"]
+            if rng.random() < 0.5:
+                lines.append("if pod.num_gpu > 0:")
+                lines.append(f"        score = score * {round(rng.uniform(0.8, 1.2), 3)}")
+            return "\n    ".join(lines)
+
+
+class CandidateGenerator:
+    """Backend + template + validation = candidate factory (reference:
+    safe_execution.py:283-317 ``generate_policy``): returns a full validated
+    candidate source, or None on any failure."""
+
+    def __init__(self, backend: TextBackend, smoke: bool = True):
+        self.backend = backend
+        self.smoke = smoke
+
+    def generate(self, parents: Sequence[Parent], feedback: str = "") -> Optional[str]:
+        try:
+            logic = self.backend.complete(template.build_prompt(parents, feedback))
+        except Exception:  # noqa: BLE001 — network/API errors -> skip
+            return None
+        if not logic:
+            return None
+        code = template.fill_template(_strip_fences(logic))
+        if not sandbox.validate(code):
+            return None
+        try:
+            transpiler.transpile(code)  # TPU-tightened third stage
+        except transpiler.TranspileError:
+            return None
+        if self.smoke and sandbox.smoke_test(code) is not None:
+            return None
+        return code
+
+
+def _strip_fences(text: str) -> str:
+    """Real LLMs wrap output in ``` fences despite instructions; unwrap."""
+    t = text.strip()
+    if t.startswith("```"):
+        lines = t.splitlines()
+        lines = lines[1:]
+        if lines and lines[-1].strip().startswith("```"):
+            lines = lines[:-1]
+        t = "\n".join(lines).strip()
+    return t
+
+
+def generate_many(gen: CandidateGenerator, n: int,
+                  sample_parents: Callable[[], Sequence[Parent]],
+                  feedback: str = "", max_workers: int = 8) -> List[str]:
+    """Thread-pool fan-out of n generation attempts (reference:
+    funsearch_integration.py:512-525); failures are dropped, so the result
+    may be shorter than n."""
+    out: List[str] = []
+    with concurrent.futures.ThreadPoolExecutor(max_workers=max_workers) as ex:
+        futs = [ex.submit(gen.generate, sample_parents(), feedback)
+                for _ in range(n)]
+        for f in concurrent.futures.as_completed(futs):
+            code = f.result()
+            if code is not None:
+                out.append(code)
+    return out

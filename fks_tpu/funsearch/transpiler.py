@@ -161,9 +161,13 @@ class _Interp:
         self.nodes = nodes
         self.returned = jnp.zeros(self.n, bool)
         self.retval = jnp.zeros(self.n, jnp.int32)
-        # lanes where Python would have raised (int() of a non-finite);
-        # they refuse at the end instead of aborting the candidate
+        # lanes where Python would have raised (int() of a non-finite,
+        # min()/max() of an empty generator, read of a variable the taken
+        # path never assigned); they refuse at the end instead of aborting
+        # the whole candidate
         self.poison = jnp.zeros(self.n, bool)
+        # per-variable "assigned on this lane" masks; absent = all lanes
+        self.defined: Dict[str, Any] = {}
 
     # ----- statements
 
@@ -179,7 +183,7 @@ class _Interp:
         elif isinstance(st, ast.AugAssign):
             if not isinstance(st.target, ast.Name):
                 raise TranspileError("only simple augmented assignment")
-            cur = self.load(st.target.id)
+            cur = self.load(st.target.id, mask)
             val = self.binop(st.op, cur, self.eval(st.value, mask))
             self.assign(st.target.id, val, mask)
         elif isinstance(st, ast.If):
@@ -248,10 +252,12 @@ class _Interp:
         if (isinstance(node, ast.Call) and isinstance(node.func, ast.Name)):
             if node.func.id == "range":
                 args = [self.eval(a, mask) for a in node.args]
+                _check_arity("range", len(args))
                 if not all(isinstance(a, int) for a in args):
                     raise TranspileError("range() bounds must be static ints")
                 return range(*args)
             if node.func.id == "enumerate":
+                _check_arity("enumerate", len(node.args))
                 inner = self.eval(node.args[0], mask)
                 if isinstance(inner, _GpuList):
                     return _EnumGpus(inner)
@@ -274,17 +280,25 @@ class _Interp:
                 self.env[name] = val  # stay scalar on unconditional paths
             else:
                 self.env[name] = _where(active, val, old)
+            if name in self.defined:
+                self.defined[name] = self.defined[name] | active
         else:
             if isinstance(val, (int, float)) and all_active:
                 self.env[name] = val
             else:
-                # first assignment under a condition: other lanes see 0,
-                # mirroring "NameError on the untaken path" as a refusal
+                # first assignment under a condition: untaken lanes hold a
+                # placeholder 0 and are poisoned if they ever READ it
+                # (Python raises UnboundLocalError there -> candidate
+                # fitness 0 in the reference; here the lane refuses)
                 self.env[name] = _where(active, val, 0)
+                if not all_active:
+                    self.defined[name] = active
 
-    def load(self, name: str):
+    def load(self, name: str, mask=None):
         if name not in self.env:
             raise TranspileError(f"undefined variable {name!r}")
+        if mask is not None and name in self.defined:
+            self.poison = self.poison | (mask & ~self.defined[name])
         return self.env[name]
 
     # ----- expressions
@@ -295,7 +309,7 @@ class _Interp:
                 return node.value
             raise TranspileError(f"unsupported constant {node.value!r}")
         if isinstance(node, ast.Name):
-            return self.load(node.id)
+            return self.load(node.id, mask)
         if isinstance(node, ast.Attribute):
             base = self.eval(node.value, mask)
             if isinstance(base, _Pod) or isinstance(base, _Node) \
@@ -317,33 +331,43 @@ class _Interp:
                 return (not t) if isinstance(t, bool) else jnp.logical_not(t)
             raise TranspileError("unsupported unary operator")
         if isinstance(node, ast.BoolOp):
-            vals = [self.eval(v, mask) for v in node.values]
-            out = vals[0]
-            for v in vals[1:]:
+            # later operands evaluate under the lanes where Python would
+            # actually reach them (short-circuit narrowing), so side effects
+            # (poison) in an unreached operand can't leak
+            out = self.eval(node.values[0], mask)
+            reach = mask
+            for v in node.values[1:]:
                 t = _truthy(out)
                 if isinstance(t, bool):
-                    out = (v if t else out) if isinstance(node.op, ast.And) \
-                        else (out if t else v)
+                    if isinstance(node.op, ast.And):
+                        out = self.eval(v, reach) if t else out
+                    else:
+                        out = out if t else self.eval(v, reach)
                 elif isinstance(node.op, ast.And):
-                    out = _where(t, v, out)
+                    reach = reach & t
+                    out = _where(t, self.eval(v, reach), out)
                 else:
-                    out = _where(t, out, v)
+                    reach = reach & ~t
+                    out = _where(t, out, self.eval(v, reach))
             return out
         if isinstance(node, ast.Compare):
             left = self.eval(node.left, mask)
             result = None
+            reach = mask
             for op, rhs_node in zip(node.ops, node.comparators):
-                rhs = self.eval(rhs_node, mask)
+                rhs = self.eval(rhs_node, reach)
                 c = self.compare(op, left, rhs)
                 result = c if result is None else jnp.logical_and(result, c)
+                if not isinstance(result, bool):
+                    reach = reach & result  # chained comparisons short-circuit
                 left = rhs
             return result
         if isinstance(node, ast.IfExp):
             cond = _truthy(self.eval(node.test, mask))
-            a = self.eval(node.body, mask)
-            b = self.eval(node.orelse, mask)
             if isinstance(cond, bool):
-                return a if cond else b
+                return self.eval(node.body if cond else node.orelse, mask)
+            a = self.eval(node.body, mask & cond)
+            b = self.eval(node.orelse, mask & ~cond)
             return _where(cond, a, b)
         if isinstance(node, ast.Call):
             return self.call(node, mask)
@@ -401,6 +425,7 @@ class _Interp:
             if isinstance(f.value, ast.Name) and f.value.id == "math" \
                     and f.attr in _MATH_FNS:
                 args = [self.eval(a, mask) for a in node.args]
+                _check_arity(f"math.{f.attr}", len(args))
                 return _MATH_FNS[f.attr](*args)
             raise TranspileError("only math.<fn> attribute calls allowed")
         if not isinstance(f, ast.Name):
@@ -413,6 +438,7 @@ class _Interp:
             return self.reduce_genexp(name, node.args[0], mask)
 
         args = [self.eval(a, mask) for a in node.args]
+        _check_arity(name, len(args))
         if name == "abs":
             (a,) = args
             return abs(a) if isinstance(a, (int, float)) else jnp.abs(a)
@@ -493,6 +519,10 @@ class _Interp:
         sel = jnp.stack(conds, axis=1)
         if name == "sum":
             return jnp.sum(jnp.where(sel, vals, 0), axis=1)
+        # Python min()/max() of an empty iterable raises (-> reference maps
+        # the candidate to fitness 0); lanes whose generator selects nothing
+        # are poisoned so the identity sentinel can never leak as a score
+        self.poison = self.poison | (mask & ~jnp.any(sel, axis=1))
         if jnp.issubdtype(vals.dtype, jnp.integer):
             info = jnp.iinfo(vals.dtype)
             big = info.max if name == "min" else info.min
@@ -505,6 +535,24 @@ class _Interp:
 class _EnumGpus:
     def __init__(self, gpus: _GpuList):
         self.gpus = gpus
+
+
+#: name -> (min_args, max_args) for whitelisted calls; malformed arity must
+#: reject the candidate (TranspileError), not crash the evolution loop
+_ARITY = {
+    "abs": (1, 1), "len": (1, 1), "int": (1, 1), "float": (1, 1),
+    "bool": (1, 1), "round": (1, 2), "min": (2, None), "max": (2, None),
+    "range": (1, 3), "enumerate": (1, 1),
+    "math.sqrt": (1, 1), "math.log": (1, 1), "math.exp": (1, 1),
+    "math.pow": (2, 2), "math.sin": (1, 1), "math.cos": (1, 1),
+    "math.tan": (1, 1),
+}
+
+
+def _check_arity(name: str, n: int) -> None:
+    lo, hi = _ARITY.get(name, (0, None))
+    if n < lo or (hi is not None and n > hi):
+        raise TranspileError(f"{name}() called with {n} argument(s)")
 
 
 def _is_py(*vals):

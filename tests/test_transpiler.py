@@ -3,6 +3,8 @@ source must agree, node for node, with plain scalar Python execution of the
 SAME source in the sandbox (the per-(pod,node) interpretation the reference
 uses, reference: funsearch/funsearch_integration.py:67-101). This oracle
 check is the transpiler's correctness bar."""
+import zlib
+
 import numpy as np
 import jax.numpy as jnp
 import pytest
@@ -109,7 +111,7 @@ def test_transpiled_matches_scalar_oracle(name):
     code = template.fill_template(LOGIC_BLOCKS[name])
     assert sandbox.validate(code), name
     policy = transpiler.transpile(code)
-    rng = np.random.default_rng(hash(name) % 2**31)
+    rng = np.random.default_rng(zlib.crc32(name.encode()))
     for trial in range(8):
         pod, nodes, spod, snodes = random_state(rng)
         got = np.asarray(policy(pod, nodes))
@@ -160,11 +162,64 @@ def test_nonfinite_lanes_refuse():
     "for i in range(1000000):\n        score = 1",  # unbounded unroll
     "score = node.gpus[0].gpu_milli_left",  # subscript not lowered
     "score = pod.nonexistent_field",
+    "score = abs()",                      # wrong arity must not escape
+    "score = min(5)",
+    "score = math.sqrt(1, 2)",
+    "for i in range():\n        score = 1",
 ])
 def test_unsupported_subset_raises(bad_logic):
     code = template.fill_template(bad_logic)
     with pytest.raises(transpiler.TranspileError):
         transpiler.transpile(code)
+
+
+def _lane_scores(logic, rng_seed=11):
+    code = template.fill_template(logic)
+    policy = transpiler.transpile(code)
+    rng = np.random.default_rng(rng_seed)
+    pod, nodes, spod, snodes = random_state(rng)
+    return code, np.asarray(policy(pod, nodes)), spod, snodes
+
+
+def test_empty_generator_minmax_poisons_lane():
+    """min() over zero GPUs raises in Python (candidate -> fitness 0 in the
+    reference); the lowered lane must refuse, never leak the int sentinel."""
+    code, got, spod, snodes = _lane_scores(
+        "score = min(gpu.gpu_milli_left for gpu in node.gpus)")
+    for i, sn in enumerate(snodes):
+        if len(sn.gpus) == 0:
+            assert got[i] == 0
+        else:
+            fn = sandbox.compile_policy(code)
+            assert got[i] == int(fn(spod, sn))
+
+
+def test_untaken_ifexp_arm_does_not_poison():
+    """int(inf) in the arm Python would never evaluate must not poison."""
+    logic = ("score = int(100.0 / (node.gpu_left * 0)) "
+             "if node.gpu_left > 9999 else 5")
+    code, got, spod, snodes = _lane_scores(logic)
+    fn = sandbox.compile_policy(code)
+    want = [int(fn(spod, sn)) for sn in snodes]
+    assert got.tolist() == want  # every feasible node scores 5
+
+
+def test_conditionally_unbound_read_poisons():
+    """Reading a variable only assigned on the untaken branch raises
+    UnboundLocalError in Python; those lanes must refuse."""
+    logic = ("if node.gpu_left > 0:\n"
+             "        bonus = 5\n"
+             "    score = 10 + bonus")
+    code, got, spod, snodes = _lane_scores(logic)
+    fn = sandbox.compile_policy(code)
+    for i, sn in enumerate(snodes):
+        try:
+            want = int(fn(spod, sn))
+        except sandbox.PolicyRuntimeError:
+            want = 0  # reference: candidate aborts; our lane refuses
+        except Exception:
+            want = 0
+        assert got[i] == want, i
 
 
 def test_canonical_key_ignores_formatting():
