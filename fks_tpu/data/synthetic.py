@@ -1,0 +1,169 @@
+"""Synthetic trace generation + shape bucketing for multi-trace batching.
+
+Two scale axes the shipped traces don't cover (SURVEY.md §5 "long-context"
+note and BASELINE.json configs 4-5):
+
+- **synthetic workloads** up to 100k pods x 1k nodes, statistically shaped
+  like the OpenB default trace (SURVEY.md §2 fine print 11: mostly 1-GPU
+  pods, a tail of 2/4/8-GPU jobs, ~13% CPU-only; node park mixing CPU-only
+  and 2/4/8-GPU machines of 1000 milli per GPU);
+- **shape buckets**: traces of different sizes padded up to shared
+  (N, G, P) shapes so one jitted simulator program serves a whole bucket —
+  XLA recompiles per shape, so bucketing bounds compile count while padding
+  waste stays bounded by the bucket growth factor.
+
+Pure host-side numpy; deterministic per seed.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Sequence, Tuple
+
+import numpy as np
+
+from fks_tpu.data.build import make_workload
+from fks_tpu.data.entities import ClusterArrays, PodArrays, Workload
+
+#: Node archetypes: (weight, cpu_milli, memory_mib, gpu_count).
+#: Shaped after the default 16-node park (10x 2-GPU, 1x 4-GPU, 5x 8-GPU,
+#: reference: benchmarks/traces/csv/gpu_models_filtered.csv) plus the
+#: CPU-only machines present in the 1,523-node full park.
+_NODE_TYPES = (
+    (0.25, 32000, 131072, 0),
+    (0.35, 64000, 262144, 2),
+    (0.15, 96000, 393216, 4),
+    (0.25, 128000, 786432, 8),
+)
+
+#: num_gpu distribution for GPU pods (reference default trace:
+#: {1: 6989, 2: 16, 4: 15, 8: 44} of 7,064 GPU pods).
+_GPU_COUNTS = ((1, 0.9894), (2, 0.0023), (4, 0.0021), (8, 0.0062))
+
+
+def synthetic_workload(num_nodes: int, num_pods: int, seed: int = 0,
+                       horizon: int = 12_900_000,
+                       gpu_pod_frac: float = 0.8665,
+                       pad_to: Tuple[int, int, int] | None = None) -> Workload:
+    """Generate a cluster + pod stream of the requested size.
+
+    ``horizon`` is the creation-time span (default: the default trace's
+    ~12.9M-second span, SURVEY.md §2 fine print 11). ``pad_to`` optionally
+    forces (N, G, P) padded shapes (used by bucketing).
+    """
+    rng = np.random.default_rng(seed)
+
+    weights = np.array([t[0] for t in _NODE_TYPES])
+    kinds = rng.choice(len(_NODE_TYPES), size=num_nodes, p=weights / weights.sum())
+    nodes = []
+    for i, k in enumerate(kinds):
+        _, cpu, mem, ng = _NODE_TYPES[k]
+        nodes.append({
+            "node_id": f"snode-{i:05d}", "cpu_milli": int(cpu),
+            "memory_mib": int(mem), "gpus": [1000] * ng,
+            "gpu_memory_mib": 16384,
+        })
+
+    is_gpu = rng.random(num_pods) < gpu_pod_frac
+    counts = np.array([c for c, _ in _GPU_COUNTS])
+    probs = np.array([p for _, p in _GPU_COUNTS])
+    num_gpu = np.where(
+        is_gpu, rng.choice(counts, size=num_pods, p=probs / probs.sum()), 0)
+    gpu_milli = np.where(
+        is_gpu, rng.choice((100, 250, 500, 1000), size=num_pods,
+                           p=(0.2, 0.3, 0.3, 0.2)), 0)
+    creation = np.sort(rng.integers(0, horizon, num_pods))
+    duration = rng.integers(60, max(61, horizon // 4), num_pods)
+    cpu = rng.integers(100, 16000, num_pods)
+    mem = rng.integers(128, 65536, num_pods)
+
+    pods = [{
+        "pod_id": f"spod-{i:06d}", "cpu_milli": int(cpu[i]),
+        "memory_mib": int(mem[i]), "num_gpu": int(num_gpu[i]),
+        "gpu_milli": int(gpu_milli[i]), "creation_time": int(creation[i]),
+        "duration_time": int(duration[i]),
+    } for i in range(num_pods)]
+
+    pad = {}
+    if pad_to is not None:
+        pad = {"pad_nodes_to": pad_to[0], "pad_gpus_to": pad_to[1],
+               "pad_pods_to": pad_to[2]}
+    return make_workload(nodes, pods, **pad)
+
+
+# ------------------------------------------------------------- bucketing
+
+def _round_up(x: int, quantum: int) -> int:
+    return max(quantum, -(-x // quantum) * quantum)
+
+
+@dataclasses.dataclass(frozen=True)
+class BucketShape:
+    """A shared padded shape (one jit compilation per bucket)."""
+
+    n: int  # padded node count
+    g: int  # padded per-node GPU count
+    p: int  # padded pod count
+
+
+def bucket_shape(wl: Workload, node_quantum: int = 16,
+                 pod_quantum: int = 2048) -> BucketShape:
+    """Round a workload's natural shape up to bucket boundaries. GPU width
+    rounds to the next power of two (it enters a u32 bitmask, cap 32)."""
+    g = 1
+    while g < max(1, wl.cluster.g_padded):
+        g *= 2
+    return BucketShape(
+        n=_round_up(wl.cluster.num_nodes or 1, node_quantum),
+        g=min(g, 32),
+        p=_round_up(wl.num_pods or 1, pod_quantum))
+
+
+def pad_workload(wl: Workload, shape: BucketShape) -> Workload:
+    """Re-pad an existing workload's arrays to a bucket shape (masks keep
+    padding out of every decision and denominator)."""
+    c, p = wl.cluster, wl.pods
+    if shape.n < c.num_nodes or shape.g < c.g_padded \
+            or shape.p < p.num_pods:
+        raise ValueError(f"bucket {shape} smaller than workload "
+                         f"({c.num_nodes}, {c.g_padded}, {p.num_pods})")
+
+    def pad1(a, target):
+        a = np.asarray(a)
+        out = np.zeros((target,) + a.shape[1:], a.dtype)
+        out[: a.shape[0]] = a
+        return out
+
+    def pad2(a, tn, tg):
+        a = np.asarray(a)
+        out = np.zeros((tn, tg), a.dtype)
+        out[: a.shape[0], : a.shape[1]] = a
+        return out
+
+    cluster = ClusterArrays(
+        cpu_total=pad1(c.cpu_total, shape.n), mem_total=pad1(c.mem_total, shape.n),
+        gpu_declared=pad1(c.gpu_declared, shape.n),
+        num_gpus=pad1(c.num_gpus, shape.n),
+        gpu_milli_total=pad2(c.gpu_milli_total, shape.n, shape.g),
+        gpu_mem_total=pad2(c.gpu_mem_total, shape.n, shape.g),
+        gpu_mask=pad2(c.gpu_mask, shape.n, shape.g),
+        node_mask=pad1(c.node_mask, shape.n), node_ids=c.node_ids)
+    pods = PodArrays(
+        cpu=pad1(p.cpu, shape.p), mem=pad1(p.mem, shape.p),
+        num_gpu=pad1(p.num_gpu, shape.p), gpu_milli=pad1(p.gpu_milli, shape.p),
+        creation_time=pad1(p.creation_time, shape.p),
+        duration=pad1(p.duration, shape.p), tie_rank=pad1(p.tie_rank, shape.p),
+        pod_mask=pad1(p.pod_mask, shape.p), pod_ids=p.pod_ids)
+    return Workload(cluster=cluster, pods=pods)
+
+
+def bucket_workloads(workloads: Sequence[Workload],
+                     node_quantum: int = 16, pod_quantum: int = 2048,
+                     ) -> Dict[BucketShape, List[Workload]]:
+    """Group workloads by shared padded shape. Each bucket's members are
+    re-padded identically, so one compiled simulator program (per policy)
+    serves the whole bucket — the BASELINE.json config-4 multi-trace story."""
+    out: Dict[BucketShape, List[Workload]] = {}
+    for wl in workloads:
+        shape = bucket_shape(wl, node_quantum, pod_quantum)
+        out.setdefault(shape, []).append(pad_workload(wl, shape))
+    return out

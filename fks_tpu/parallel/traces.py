@@ -1,0 +1,132 @@
+"""Multi-trace batching: vmap the simulator over a stacked trace axis.
+
+BASELINE.json config 4 ("multi-trace batch, padded lax.scan, shape-bucketed
+jit") done the TPU-native way: traces inside one shape bucket
+(fks_tpu.data.synthetic.bucket_workloads) are stacked leaf-by-leaf into one
+pytree with a leading trace axis ``T`` and the whole engine runs under
+``vmap`` — ONE compiled program per (bucket shape, policy), regardless of
+how many traces it serves. The reference has no analogue: its benchmark
+harness re-runs the Python simulator per trace file
+(reference: tests/test_scheduler.py:245-284 one deep-copied run per policy,
+benchmarks/parser.py:103-115 per-file discovery).
+
+Composes with the population axis: ``make_trace_batch_eval`` optionally
+vmaps params too -> fitness[C, T] from one program.
+"""
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fks_tpu.data.entities import ClusterArrays, PodArrays, Workload
+from fks_tpu.models import parametric
+from fks_tpu.parallel.population import ParamPolicyFn
+from fks_tpu.sim.engine import SimConfig, build_step, finalize, initial_state
+from fks_tpu.sim.evaluator import max_snapshot_count, snapshot_trigger_table
+from fks_tpu.sim.types import SimState
+
+
+def _strip_ids(wl: Workload) -> Workload:
+    """Drop host-side id tuples (static pytree meta) so same-shape workloads
+    share one treedef and can stack under vmap."""
+    return Workload(
+        cluster=ClusterArrays(**{
+            **{f: getattr(wl.cluster, f) for f in (
+                "cpu_total", "mem_total", "gpu_declared", "num_gpus",
+                "gpu_milli_total", "gpu_mem_total", "gpu_mask", "node_mask")},
+            "node_ids": ()}),
+        pods=PodArrays(**{
+            **{f: getattr(wl.pods, f) for f in (
+                "cpu", "mem", "num_gpu", "gpu_milli", "creation_time",
+                "duration", "tie_rank", "pod_mask")},
+            "pod_ids": ()}))
+
+
+def stack_traces(workloads: Sequence[Workload], cfg: SimConfig):
+    """Stack same-shape workloads into (workload[T,...], ktable[T,K],
+    state0[T,...], max_steps).
+
+    Host-side prep: per-trace snapshot tables are sized from each trace's
+    REAL pod count (the reference's ``initialize(total_events)``,
+    evaluator.py:47-53) then padded with an unreachable sentinel to a shared
+    width; initial heaps are built per trace by real CPython heapq.
+    """
+    if not workloads:
+        raise ValueError("no workloads")
+    shapes = {(w.cluster.n_padded, w.cluster.g_padded, w.pods.p_padded)
+              for w in workloads}
+    if len(shapes) != 1:
+        raise ValueError(f"workloads span multiple padded shapes {shapes}; "
+                         "bucket them first (fks_tpu.data.synthetic)")
+    max_steps = max(cfg.resolve_max_steps(w.num_pods) for w in workloads)
+    ktables = [snapshot_trigger_table(
+        w.num_pods,
+        max_snapshot_count(max_steps, w.num_pods, cfg.snapshot_interval),
+        cfg.snapshot_interval) for w in workloads]
+    klen = max(len(k) for k in ktables)
+    sentinel = np.iinfo(np.int32).max
+    kt = np.full((len(workloads), klen), sentinel, np.int32)
+    for i, k in enumerate(ktables):
+        kt[i, : len(k)] = k
+
+    states = [initial_state(w, cfg) for w in workloads]
+    hist_sizes = {s.wait_hist.shape[0] for s in states}
+    if len(hist_sizes) != 1:
+        raise ValueError(f"wait histogram sizes differ across traces "
+                         f"{hist_sizes}; traces exceed the shared gpu_milli "
+                         "range — split the bucket")
+
+    stacked_wl = jax.tree_util.tree_map(
+        lambda *xs: jnp.stack([jnp.asarray(x) for x in xs]),
+        *[_strip_ids(w) for w in workloads])
+    stacked_state = jax.tree_util.tree_map(
+        lambda *xs: jnp.stack([jnp.asarray(x) for x in xs]), *states)
+    return stacked_wl, jnp.asarray(kt), stacked_state, max_steps
+
+
+def make_trace_run(cfg: SimConfig, max_steps: int,
+                   param_policy: ParamPolicyFn = parametric.score):
+    """``run(workload, ktable, params, state) -> SimResult`` with the
+    workload as a TRACED argument (one compilation per shape, not per
+    trace). ``max_steps`` must be static: it bounds the while_loop."""
+
+    def cond(s: SimState):
+        return (s.heap.size > 0) & ~s.failed & (s.steps < max_steps)
+
+    def run(workload, ktable, params, state):
+        step = build_step(
+            workload, lambda pod, nodes: param_policy(params, pod, nodes),
+            cfg, ktable)
+        final = jax.lax.while_loop(cond, step, state)
+        return finalize(workload, cfg, final)
+
+    return run
+
+
+def make_trace_batch_eval(workloads: Sequence[Workload],
+                          param_policy: ParamPolicyFn = parametric.score,
+                          cfg: SimConfig = SimConfig(),
+                          population: bool = False,
+                          jit: bool = True):
+    """Build ``eval(params) -> SimResult`` batched over the trace axis T.
+
+    ``population=False``: params is one candidate, results have leading
+    axis [T]. ``population=True``: params[C, ...] adds an outer candidate
+    vmap -> results [C, T] (fitness of every candidate on every trace from
+    one program — the full config-4 matrix).
+    """
+    wl, kt, state0, max_steps = stack_traces(workloads, cfg)
+    run = make_trace_run(cfg, max_steps, param_policy)
+
+    def eval_traces(params):
+        per_trace = jax.vmap(lambda w, k, s: run(w, k, params, s))
+        return per_trace(wl, kt, state0)
+
+    if population:
+        fn = jax.vmap(eval_traces)
+    else:
+        fn = eval_traces
+    return jax.jit(fn) if jit else fn

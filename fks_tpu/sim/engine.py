@@ -97,6 +97,7 @@ def initial_state(workload: Workload, cfg: SimConfig) -> SimState:
         max_nodes=jnp.int32(0),
         failed=jnp.bool_(False),
         steps=jnp.int32(0),
+        violations=jnp.int32(0),
     )
 
 
@@ -113,24 +114,28 @@ def _node_view(c: ClusterArrays, cpu_left, mem_left, gpu_left, gpu_milli_left):
 
 def build_step(workload: Workload, policy: PolicyFn, cfg: SimConfig,
                ktable) -> Callable[[SimState], SimState]:
-    """One event: the body of the while_loop. See module docstring."""
+    """One event: the body of the while_loop. See module docstring.
+
+    ``workload`` arrays and ``ktable`` may be tracers (the multi-trace path
+    passes them as jit/vmap arguments so one compiled program serves every
+    same-shape trace); all totals are therefore computed with jnp ops, which
+    XLA constant-folds when the workload is a compile-time constant."""
     c, p = workload.cluster, workload.pods
-    # host-side totals first: build_step may run under an active trace (the
-    # population layer calls it with tracer params), where converted arrays
-    # can no longer round-trip through numpy
-    totals = c.totals()
     # device-resident copies (parser emits numpy; tracers can't index numpy)
     c = jax.tree_util.tree_map(jnp.asarray, c)
     p = jax.tree_util.tree_map(jnp.asarray, p)
     n, g = workload.cluster.n_padded, workload.cluster.g_padded
     f = cfg.score_dtype
     alloc = best_fit_gpus if cfg.gpu_allocator == "best_fit" else first_fit_gpus
-    total_cpu, total_mem = totals["cpu"], totals["memory"]
-    total_gc, total_gm = totals["gpu_count"], totals["gpu_milli"]
+    # cluster-wide capacity totals (reference: evaluator.py:35-38); padding
+    # rows are zero so plain sums are exact
+    total_cpu = jnp.sum(c.cpu_total)
+    total_mem = jnp.sum(c.mem_total)
+    total_gc = jnp.sum(c.num_gpus)
+    total_gm = jnp.sum(c.gpu_milli_total)
     g_iota = jnp.arange(g, dtype=jnp.uint32)
     ktable = jnp.asarray(ktable, jnp.int32)
     klen = ktable.shape[0]
-    hist_iota = None  # built lazily from state shape
 
     def step(s: SimState) -> SimState:
         h, (t, rk, kind, pod) = heap_pop(s.heap)
@@ -197,7 +202,7 @@ def build_step(workload: Workload, policy: PolicyFn, cfg: SimConfig,
         frag_score = jnp.where(
             has_gpu_waiting & (total_gm > 0),
             jnp.sum(frag_free, dtype=jnp.int64 if jnp.int64 == jnp.asarray(0).dtype else jnp.int32).astype(f)
-            / jnp.asarray(max(total_gm, 1), f),
+            / jnp.maximum(total_gm, 1).astype(f),
             jnp.asarray(0, f))
         frag_sum = s.frag_sum + jnp.where(failp, frag_score, 0)
         frag_count = s.frag_count + failp.astype(jnp.int32)
@@ -216,17 +221,14 @@ def build_step(workload: Workload, policy: PolicyFn, cfg: SimConfig,
         fire = valid & (s.snap_idx < klen) & (
             events >= ktable[jnp.minimum(s.snap_idx, klen - 1)])
         used = jnp.stack([
-            jnp.asarray(total_cpu - jnp.sum(cpu_left), f),
-            jnp.asarray(total_mem - jnp.sum(mem_left), f),
-            jnp.asarray(jnp.sum(c.num_gpus - gpu_left), f),
-            jnp.asarray(total_gm - jnp.sum(gpu_milli_left), f),
+            (total_cpu - jnp.sum(cpu_left)).astype(f),
+            (total_mem - jnp.sum(mem_left)).astype(f),
+            jnp.sum(c.num_gpus - gpu_left).astype(f),
+            (total_gm - jnp.sum(gpu_milli_left)).astype(f),
         ])
-        denom = jnp.asarray(
-            [max(total_cpu, 1), max(total_mem, 1), max(total_gc, 1),
-             max(total_gm, 1)], f)
-        zero_total = jnp.asarray(
-            [total_cpu <= 0, total_mem <= 0, total_gc <= 0, total_gm <= 0], bool)
-        utils = jnp.where(zero_total, 0, used / denom)
+        totals_vec = jnp.stack([total_cpu, total_mem, total_gc, total_gm])
+        denom = jnp.maximum(totals_vec, 1).astype(f)
+        utils = jnp.where(totals_vec <= 0, 0, used / denom)
         snap_sums = s.snap_sums + jnp.where(fire, utils, 0)
         snap_idx = s.snap_idx + fire.astype(jnp.int32)
 
@@ -234,6 +236,12 @@ def build_step(workload: Workload, policy: PolicyFn, cfg: SimConfig,
             (cpu_left < c.cpu_total) | (mem_left < c.mem_total)
             | (gpu_left < c.num_gpus))), dtype=jnp.int32)
         max_nodes = jnp.maximum(s.max_nodes, jnp.where(valid, active, 0))
+
+        violations = s.violations
+        if cfg.validate_invariants:
+            violations = violations + _audit(
+                c, p, heap3, cpu_left, mem_left, gpu_left, gpu_milli_left,
+                assigned_node, assigned_gpus)
 
         return SimState(
             heap=heap3, cpu_left=cpu_left, mem_left=mem_left,
@@ -243,9 +251,58 @@ def build_step(workload: Workload, policy: PolicyFn, cfg: SimConfig,
             events_processed=events, snap_idx=snap_idx, snap_sums=snap_sums,
             frag_sum=frag_sum, frag_count=frag_count, max_nodes=max_nodes,
             failed=s.failed | alloc_fail, steps=s.steps + 1,
+            violations=violations,
         )
 
     return step
+
+
+def _audit(c: ClusterArrays, p: PodArrays, heap, cpu_left, mem_left,
+           gpu_left, gpu_milli_left, assigned_node, assigned_gpus):
+    """Opt-in full-state audit after every event — the reference's
+    invariant checker semantics (reference: simulator/main.py:201-272):
+    non-negative remnants, remnant <= total, and conservation
+    (used == total - remaining) at node and per-GPU granularity,
+    cross-checked against the pods whose DELETE is still pending.
+    Returns i32 1 if any invariant fails at this step.
+
+    The reference raises on first violation; a jitted loop cannot, so
+    violations are counted into the carry instead (checkify-style)."""
+    n, g = c.gpu_mask.shape
+    pp = assigned_node.shape[0]
+
+    nm = c.node_mask
+    neg = (jnp.any(nm & (cpu_left < 0)) | jnp.any(nm & (mem_left < 0))
+           | jnp.any(nm & (gpu_left < 0))
+           | jnp.any(c.gpu_mask & (gpu_milli_left < 0)))
+    over = (jnp.any(nm & (cpu_left > c.cpu_total))
+            | jnp.any(nm & (mem_left > c.mem_total))
+            | jnp.any(nm & (gpu_left > c.gpu_declared))
+            | jnp.any(c.gpu_mask & (gpu_milli_left > c.gpu_milli_total)))
+
+    # pods currently occupying resources = pods with a pending DELETE event
+    hi = jnp.arange(heap.pod.shape[0])
+    pending_delete = (hi < heap.size) & (heap.kind == jnp.int8(KIND_DELETE))
+    active = jnp.zeros(pp, bool).at[heap.pod].max(pending_delete)
+    active = active & (assigned_node >= 0)
+    seg = jnp.clip(assigned_node, 0, n - 1)
+
+    def used_by_node(req):
+        return jax.ops.segment_sum(
+            jnp.where(active, req, 0), seg, num_segments=n)
+
+    cons = (jnp.any(nm & (c.cpu_total - cpu_left != used_by_node(p.cpu)))
+            | jnp.any(nm & (c.mem_total - mem_left != used_by_node(p.mem)))
+            | jnp.any(nm & (c.gpu_declared - gpu_left != used_by_node(p.num_gpu))))
+
+    # per-GPU milli conservation: expand each active pod's GPU bitmask
+    g_iota = jnp.arange(g, dtype=jnp.uint32)
+    bits = ((assigned_gpus[:, None] >> g_iota[None, :]) & 1).astype(jnp.int32)
+    contrib = jnp.where(active[:, None], bits * p.gpu_milli[:, None], 0)  # [P,G]
+    used_milli = jax.ops.segment_sum(contrib, seg, num_segments=n)  # [N,G]
+    cons_g = jnp.any(c.gpu_mask & (c.gpu_milli_total - gpu_milli_left != used_milli))
+
+    return (neg | over | cons | cons_g).astype(jnp.int32)
 
 
 def _gpu_count_used(c: ClusterArrays, gpu_left):
@@ -282,7 +339,7 @@ def finalize(workload: Workload, cfg: SimConfig, s: SimState) -> SimResult:
         assigned_gpus=s.assigned_gpus, pod_ctime=s.pod_ctime,
         cpu_left=s.cpu_left, mem_left=s.mem_left, gpu_left=s.gpu_left,
         gpu_milli_left=s.gpu_milli_left, failed=s.failed, truncated=truncated,
-        invariant_violations=jnp.int32(0),
+        invariant_violations=s.violations,
     )
 
 

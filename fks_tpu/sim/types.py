@@ -76,6 +76,7 @@ class SimState(NamedTuple):
     # control
     failed: Any  # bool: GPU allocation raised in the reference -> abort
     steps: Any  # i32
+    violations: Any  # i32: invariant-audit failures (0 unless enabled)
 
 
 class SimResult(NamedTuple):

@@ -1,0 +1,48 @@
+"""Invariant-audit mode (reference: simulator/main.py:201-272
+``_validate_cluster_invariants``, opt-in via ``validate_invariants``):
+a correct run reports zero violations; corrupted state is detected."""
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+
+from fks_tpu.models import zoo
+from fks_tpu.sim.engine import SimConfig, initial_state, make_run_fn, simulate
+from tests.test_engine_micro import micro_workload
+
+
+def test_micro_run_zero_violations():
+    wl = micro_workload()
+    res = simulate(wl, zoo.micro_best_fit(dtype=jnp.float64),
+                   SimConfig(score_dtype=jnp.float64, validate_invariants=True))
+    assert int(res.invariant_violations) == 0
+    assert not bool(res.failed)
+
+
+def test_default_trace_zero_violations(default_workload):
+    res = simulate(default_workload, zoo.ZOO["best_fit"](),
+                   SimConfig(validate_invariants=True))
+    assert int(res.invariant_violations) == 0
+    assert float(res.policy_score) > 0.4  # audit must not perturb results
+
+
+def test_corrupted_state_detected():
+    """Hand-corrupt the initial carry (a node owing more CPU than its
+    capacity allows) — every subsequent audited step must flag it."""
+    wl = micro_workload()
+    cfg = SimConfig(score_dtype=jnp.float64, validate_invariants=True)
+    state = initial_state(wl, cfg)
+    state = state._replace(cpu_left=state.cpu_left.at[0].add(-999))
+    run = make_run_fn(wl, zoo.micro_best_fit(dtype=jnp.float64), cfg)
+    res = run(state)
+    assert int(res.invariant_violations) > 0
+
+
+def test_audit_off_reports_zero_even_when_corrupt():
+    wl = micro_workload()
+    cfg = SimConfig(score_dtype=jnp.float64, validate_invariants=False)
+    state = initial_state(wl, cfg)
+    state = state._replace(cpu_left=state.cpu_left.at[0].add(-999))
+    run = make_run_fn(wl, zoo.micro_best_fit(dtype=jnp.float64), cfg)
+    res = run(state)
+    assert int(res.invariant_violations) == 0
