@@ -1,0 +1,220 @@
+"""Command-line harness: benchmark table, single runs, evolution.
+
+TPU-native counterpart of the reference's script entry points — the
+5-policy benchmark table (reference: tests/test_scheduler.py:223-361
+``SchedulerTester`` + ``main``), the integration smoke run
+(tests/test_integration.py:110-148), and the evolution CLI
+(funsearch/funsearch_integration.py:682-706) — consolidated behind one
+``argparse`` interface, which the reference lacks entirely (SURVEY.md §5:
+"no argparse/env/CLI flags anywhere").
+
+Usage:
+    python -m fks_tpu.cli bench [--policies a,b,...] [--trace F] [--nodes F]
+    python -m fks_tpu.cli simulate --policy best_fit [--validate]
+    python -m fks_tpu.cli evolve [--config F] [--fake-llm] [--checkpoint F]
+    python -m fks_tpu.cli traces
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def _maybe_x64(args):
+    if getattr(args, "f64", False):
+        import jax
+
+        jax.config.update("jax_enable_x64", True)
+
+
+def _parse_workload(args):
+    from fks_tpu.data import TraceParser
+
+    parser = TraceParser()
+    return parser, parser.parse_workload(node_file=args.nodes,
+                                         pod_file=args.trace)
+
+
+def _add_trace_flags(p):
+    p.add_argument("--trace", default="openb_pod_list_default.csv",
+                   help="pod CSV under benchmarks/traces/csv/")
+    p.add_argument("--nodes", default="gpu_models_filtered.csv",
+                   help="node CSV under benchmarks/traces/csv/")
+
+
+def _result_row(name, res, wall):
+    import numpy as np
+
+    return {
+        "policy": name,
+        "score": round(float(res.policy_score), 4),
+        "scheduled": f"{int(res.scheduled_pods)}",
+        "cpu%": round(100 * float(res.avg_cpu_utilization), 1),
+        "mem%": round(100 * float(res.avg_memory_utilization), 1),
+        "gpu%": round(100 * float(res.avg_gpu_count_utilization), 1),
+        "milli%": round(100 * float(res.avg_gpu_memory_utilization), 1),
+        "frag": round(float(res.gpu_fragmentation_score), 3),
+        "snaps": int(res.num_snapshots),
+        "events": int(res.events_processed),
+        "max_nodes": int(res.max_nodes),
+        "wall_s": round(wall, 3),
+    }
+
+
+def _print_table(rows):
+    if not rows:
+        return
+    cols = list(rows[0].keys())
+    widths = {c: max(len(c), *(len(str(r[c])) for r in rows)) for c in cols}
+    line = "  ".join(c.rjust(widths[c]) for c in cols)
+    print(line)
+    print("-" * len(line))
+    for r in rows:
+        print("  ".join(str(r[c]).rjust(widths[c]) for c in cols))
+
+
+def cmd_bench(args):
+    """The reference benchmark table (test_scheduler.py:287-331): every
+    requested policy against the workload, jit-compiled, with wall time."""
+    _maybe_x64(args)
+    import jax.numpy as jnp
+
+    from fks_tpu.models import zoo
+    from fks_tpu.sim.engine import SimConfig, simulate
+
+    _, wl = _parse_workload(args)
+    names = (args.policies.split(",") if args.policies else list(zoo.ZOO))
+    dtype = jnp.float64 if args.f64 else jnp.float32
+    cfg = SimConfig(score_dtype=dtype, validate_invariants=args.validate)
+    print(f"workload: {wl.num_nodes} nodes x {wl.num_pods} pods "
+          f"({args.nodes} x {args.trace})", file=sys.stderr)
+    rows = []
+    for name in names:
+        if name not in zoo.ZOO:
+            print(f"unknown policy {name!r}; have {list(zoo.ZOO)}",
+                  file=sys.stderr)
+            return 2
+        t0 = time.time()
+        res = simulate(wl, zoo.ZOO[name](dtype=dtype), cfg)
+        res.policy_score.block_until_ready()
+        rows.append(_result_row(name, res, time.time() - t0))
+        if args.validate and int(res.invariant_violations):
+            print(f"WARNING: {name}: {int(res.invariant_violations)} "
+                  "invariant violations", file=sys.stderr)
+    _print_table(rows)
+    return 0
+
+
+def cmd_simulate(args):
+    """Single policy, detailed output (reference: tests/test_integration.py
+    style summary)."""
+    _maybe_x64(args)
+    import jax.numpy as jnp
+    import numpy as np
+
+    from fks_tpu.models import zoo
+    from fks_tpu.sim.engine import SimConfig, simulate
+
+    _, wl = _parse_workload(args)
+    dtype = jnp.float64 if args.f64 else jnp.float32
+    cfg = SimConfig(score_dtype=dtype, validate_invariants=args.validate)
+    t0 = time.time()
+    res = simulate(wl, zoo.ZOO[args.policy](dtype=dtype), cfg)
+    res.policy_score.block_until_ready()
+    wall = time.time() - t0
+    n_pods = wl.num_pods
+    gpu_pods = int(np.sum(np.asarray(wl.pods.num_gpu)[:n_pods] > 0))
+    out = _result_row(args.policy, res, wall)
+    out.update({
+        "gpu_pods": gpu_pods, "cpu_only_pods": n_pods - gpu_pods,
+        "success_rate": round(100 * int(res.scheduled_pods) / max(1, n_pods), 2),
+        "failed": bool(res.failed), "truncated": bool(res.truncated),
+        "invariant_violations": int(res.invariant_violations),
+    })
+    print(json.dumps(out, indent=2))
+    return 0
+
+
+def cmd_evolve(args):
+    """Evolution loop (reference: funsearch_integration.py:682-706), with a
+    hermetic --fake-llm mode and checkpoint/resume the reference lacks."""
+    from fks_tpu.funsearch import EvolutionConfig, FakeLLM
+    from fks_tpu.funsearch import evolution as evo
+    from fks_tpu.sim.engine import SimConfig
+
+    cfg = (EvolutionConfig.from_json(args.config) if args.config
+           else EvolutionConfig())
+    if args.generations is not None:
+        cfg.generations = args.generations
+    backend = FakeLLM(seed=cfg.seed) if args.fake_llm else None
+    if backend is None and not cfg.llm.api_key:
+        print("no API key in config; use --fake-llm for hermetic runs",
+              file=sys.stderr)
+        return 2
+    _, wl = _parse_workload(args)
+    fs = evo.run(wl, cfg, backend=backend, sim_config=SimConfig(),
+                 checkpoint_path=args.checkpoint)
+    if fs.best:
+        print(f"best fitness: {fs.best[1]:.4f}")
+        if args.out:
+            path = fs.save_top_policies(args.out, k=5)
+            print(f"saved top policies to {path}")
+    return 0
+
+
+def cmd_traces(args):
+    """Dataset discovery (reference: parser.py:103-115)."""
+    from fks_tpu.data import TraceParser
+
+    parser = TraceParser()
+    print("node files:")
+    for f in parser.get_available_node_files():
+        print(f"  {f}")
+    print("pod files:")
+    for f in parser.get_available_pod_files():
+        print(f"  {f}")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="fks_tpu", description=__doc__)
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    b = sub.add_parser("bench", help="policy comparison table")
+    _add_trace_flags(b)
+    b.add_argument("--policies", default="",
+                   help="comma-separated zoo policy names (default: all)")
+    b.add_argument("--f64", action="store_true",
+                   help="float64 evaluator arithmetic (exact reference parity)")
+    b.add_argument("--validate", action="store_true",
+                   help="enable the per-event invariant audit")
+    b.set_defaults(fn=cmd_bench)
+
+    s = sub.add_parser("simulate", help="one policy, detailed JSON result")
+    _add_trace_flags(s)
+    s.add_argument("--policy", default="best_fit")
+    s.add_argument("--f64", action="store_true")
+    s.add_argument("--validate", action="store_true")
+    s.set_defaults(fn=cmd_simulate)
+
+    e = sub.add_parser("evolve", help="run FunSearch evolution")
+    _add_trace_flags(e)
+    e.add_argument("--config", default="", help="reference-format llm_config.json")
+    e.add_argument("--fake-llm", action="store_true",
+                   help="deterministic offline codegen backend")
+    e.add_argument("--checkpoint", default="", help="evolution checkpoint path")
+    e.add_argument("--out", default="", help="directory for champion JSONs")
+    e.add_argument("--generations", type=int, default=None)
+    e.set_defaults(fn=cmd_evolve)
+
+    t = sub.add_parser("traces", help="list available trace files")
+    t.set_defaults(fn=cmd_traces)
+
+    args = ap.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

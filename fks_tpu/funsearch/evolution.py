@@ -147,10 +147,14 @@ class FunSearch:
 
     def _is_too_similar(self, code: str, score: float) -> bool:
         """difflib ratio >= threshold against any incumbent with >= score
-        => reject (reference: funsearch_integration.py:208-215)."""
+        => reject (reference: funsearch_integration.py:208-215). Compared on
+        the evolved logic block, not the full source: every candidate shares
+        the fixed template, which would dominate a full-string ratio."""
+        logic = template.logic_of(code)
         for other_code, other_score in self.population:
             if other_score >= score:
-                ratio = difflib.SequenceMatcher(None, code, other_code).ratio()
+                ratio = difflib.SequenceMatcher(
+                    None, logic, template.logic_of(other_code)).ratio()
                 if ratio >= self.cfg.similarity_threshold:
                     return True
         return False
@@ -262,6 +266,9 @@ class FunSearch:
             "rng_state": _encode_rng(self.rng.getstate()),
             "config": dataclasses.asdict(self.cfg),
         }
+        backend = self.generator.backend
+        if hasattr(backend, "getstate"):
+            state["backend_state"] = backend.getstate()
         tmp = path + ".tmp"
         with open(tmp, "w") as f:
             json.dump(state, f)
@@ -277,6 +284,9 @@ class FunSearch:
         self.best = ((state["best"]["code"], state["best"]["score"])
                      if state["best"] else None)
         self.rng.setstate(_decode_rng(state["rng_state"]))
+        backend = self.generator.backend
+        if "backend_state" in state and hasattr(backend, "setstate"):
+            backend.setstate(state["backend_state"])
 
 
 def _encode_rng(state):

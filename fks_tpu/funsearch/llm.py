@@ -89,6 +89,16 @@ class FakeLLM:
         self._junk_rate = junk_rate
         self._lock = threading.Lock()
 
+    def getstate(self):
+        """Serializable generator state (checkpointed by the evolution
+        driver so hermetic runs resume bit-identically)."""
+        kind, internal, gauss = self._rng.getstate()
+        return [kind, list(internal), gauss]
+
+    def setstate(self, obj) -> None:
+        kind, internal, gauss = obj
+        self._rng.setstate((kind, tuple(internal), gauss))
+
     def complete(self, prompt: str) -> str:  # noqa: ARG002 — prompt unused
         with self._lock:
             rng = self._rng
@@ -155,7 +165,9 @@ def generate_many(gen: CandidateGenerator, n: int,
     with concurrent.futures.ThreadPoolExecutor(max_workers=max_workers) as ex:
         futs = [ex.submit(gen.generate, sample_parents(), feedback)
                 for _ in range(n)]
-        for f in concurrent.futures.as_completed(futs):
+        # collect in submission order (not as_completed): result order — and
+        # therefore population order and dedup outcomes — stays deterministic
+        for f in futs:
             code = f.result()
             if code is not None:
                 out.append(code)

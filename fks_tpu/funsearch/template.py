@@ -65,6 +65,20 @@ def fill_template(evolved_logic: str) -> str:
     return TEMPLATE.replace(LOGIC_PLACEHOLDER, evolved_logic.strip())
 
 
+_PREFIX, _SUFFIX = TEMPLATE.split(LOGIC_PLACEHOLDER)
+
+
+def logic_of(code: str) -> str:
+    """Extract the evolved block back out of a filled candidate; returns the
+    whole source for non-template code. Used by near-duplicate suppression:
+    comparing full candidates is meaningless when ~90% of every string is
+    the shared template boilerplate (difflib ratio would exceed any sane
+    threshold for ALL pairs)."""
+    if code.startswith(_PREFIX) and code.endswith(_SUFFIX):
+        return code[len(_PREFIX):len(code) - len(_SUFFIX)]
+    return code
+
+
 def _format_parents(parents: Sequence[Tuple[str, float]]) -> str:
     if not parents:
         return "(no prior policies yet)"

@@ -1,0 +1,26 @@
+"""CLI wiring tests (the heavy paths — full-trace bench/evolve — are
+exercised by the engine/evolution suites; here we check the argparse
+surface, discovery, and error handling)."""
+import pytest
+
+from fks_tpu import cli
+
+
+def test_traces_lists_dataset(capsys):
+    assert cli.main(["traces"]) == 0
+    out = capsys.readouterr().out
+    assert "openb_pod_list_default.csv" in out
+    assert "openb_node_list_gpu_node.csv" in out
+
+
+def test_bench_unknown_policy_errors(capsys):
+    assert cli.main(["bench", "--policies", "nope"]) == 2
+
+
+def test_evolve_requires_key_or_fake(capsys):
+    assert cli.main(["evolve"]) == 2
+
+
+def test_requires_subcommand():
+    with pytest.raises(SystemExit):
+        cli.main([])
