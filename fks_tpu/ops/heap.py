@@ -16,8 +16,16 @@ string order (event_simulator.py:16-17); ``tie_rank`` is the precomputed rank
 of the pod id in lexicographic order, so integer comparison is equivalent.
 Payload is ``(kind, pod_index)`` with kind 0=CREATION, 1=DELETION.
 
-All ops are branchless/jit-safe: sift loops are ``lax.while_loop`` with
-data-dependent (but O(log n)-bounded) trip counts; everything vmaps.
+TPU-native formulation: a sift is "insert one item into the sorted
+root-to-hole chain of slots" -- the chain is at most ``ceil(log2(cap))+1``
+slots, its indices are pure arithmetic (push) or a fixed-depth unrolled
+smaller-child descent with a *scalar* carry (pop), and the whole mutation is
+ONE gather + ONE duplicate-free scatter of <= ~14 elements. No
+data-dependent ``while_loop`` ever touches the backing arrays, so the ops
+cost O(log n) elements of HBM traffic per event and batch cleanly under
+``vmap`` (a lane-masked op is a dropped scatter, not a full-array select).
+This is what makes the engine's event loop a lean ``lax.while_loop`` body
+(SURVEY.md §7 "hard parts": 2.5M scan-steps/s/chip budget).
 """
 from __future__ import annotations
 
@@ -44,6 +52,11 @@ class EventHeap(NamedTuple):
     @property
     def capacity(self) -> int:
         return self.time.shape[0]
+
+    @property
+    def levels(self) -> int:
+        """Max root-to-leaf path length: ceil(log2(cap)) + 1."""
+        return max(1, int(np.ceil(np.log2(max(self.capacity, 2)))) + 1)
 
 
 def _less(ta, ra, tb, rb):
@@ -78,81 +91,146 @@ def heap_from_events(times, ranks, kinds, pods, capacity: int | None = None) -> 
     )
 
 
-def _get(h: EventHeap, i):
+def _gather(h: EventHeap, idx):
+    """Clamped gather of items at ``idx`` (any shape)."""
+    i = jnp.clip(idx, 0, h.capacity - 1)
     return h.time[i], h.rank[i], h.kind[i], h.pod[i]
 
 
-def _set(h: EventHeap, i, item) -> EventHeap:
-    t, r, k, p = item
-    return h._replace(
-        time=h.time.at[i].set(t),
-        rank=h.rank.at[i].set(r),
-        kind=h.kind.at[i].set(jnp.asarray(k, jnp.int8)),
-        pod=h.pod.at[i].set(p),
+def _scatter(h: EventHeap, idx, t, r, k, p, new_size) -> EventHeap:
+    """Duplicate-free drop-mode scatter of items; indices == cap are dropped."""
+    return EventHeap(
+        time=h.time.at[idx].set(t, mode="drop"),
+        rank=h.rank.at[idx].set(r, mode="drop"),
+        kind=h.kind.at[idx].set(k.astype(jnp.int8), mode="drop"),
+        pod=h.pod.at[idx].set(p, mode="drop"),
+        size=new_size,
     )
 
 
-def _siftdown(h: EventHeap, startpos, pos, newitem) -> EventHeap:
-    """CPython heapq._siftdown: bubble ``newitem`` up from ``pos``."""
-    nt, nr, _, _ = newitem
-
-    def cond(c):
-        h_, pos_ = c
-        parent = (pos_ - 1) >> 1
-        pt, pr, _, _ = _get(h_, jnp.maximum(parent, 0))
-        return (pos_ > startpos) & _less(nt, nr, pt, pr)
-
-    def body(c):
-        h_, pos_ = c
-        parent = (pos_ - 1) >> 1
-        h_ = _set(h_, pos_, _get(h_, parent))
-        return h_, parent
-
-    h, pos = jax.lax.while_loop(cond, body, (h, pos))
-    return _set(h, pos, newitem)
-
-
-def _siftup(h: EventHeap, pos, newitem, endpos) -> EventHeap:
-    """CPython heapq._siftup: walk the smaller child up to the root path from
-    ``pos``, then restore with ``_siftdown``. ``endpos`` is the live size."""
-    startpos = pos
-
-    def cond(c):
-        _, pos_, childpos = c
-        return childpos < endpos
-
-    def body(c):
-        h_, pos_, childpos = c
-        right = childpos + 1
-        ct, cr, _, _ = _get(h_, childpos)
-        rt, rr, _, _ = _get(h_, jnp.minimum(right, endpos - 1))
-        use_right = (right < endpos) & ~_less(ct, cr, rt, rr)
-        childpos = jnp.where(use_right, right, childpos)
-        h_ = _set(h_, pos_, _get(h_, childpos))
-        return h_, childpos, 2 * childpos + 1
-
-    h, pos, _ = jax.lax.while_loop(cond, body, (h, pos, 2 * pos + 1))
-    return _siftdown(h, startpos, pos, newitem)
-
-
 def heap_push(h: EventHeap, time, rank, kind, pod, pred=True) -> EventHeap:
-    """heapq.heappush; no-op when ``pred`` is False (for branchless callers)."""
+    """``heapq.heappush``; no-op when ``pred`` is False.
+
+    CPython's ``_siftdown(heap, 0, size)`` bubbles the new item up the
+    ancestor chain of the insertion slot. In a valid heap that chain is
+    sorted ascending root->leaf, so the sift is equivalent to: find the
+    insertion depth ``s`` = number of ancestors <= newitem, shift the deeper
+    ancestors down one level, write newitem at depth ``s``. All chain
+    indices are arithmetic in ``pos = size``; one gather + one scatter.
+    """
+    L = h.levels
+    cap = jnp.int32(h.capacity)
     pos = h.size
-    h2 = _siftdown(h._replace(size=h.size + 1), jnp.int32(0), pos,
-                   (time, rank, jnp.asarray(kind, jnp.int8), pod))
-    return jax.tree_util.tree_map(
-        lambda a, b: jnp.where(pred, a, b), h2, h)
+    xt = jnp.asarray(time, jnp.int32)
+    xr = jnp.asarray(rank, jnp.int32)
+    xk = jnp.asarray(kind, jnp.int8)
+    xp = jnp.asarray(pod, jnp.int32)
+    pred = jnp.asarray(pred, bool)
+
+    # depth of the insertion slot: e = floor(log2(pos + 1))
+    pos1 = pos + 1
+    e = jnp.int32(0)
+    for b in range(1, L + 1):
+        e = e + ((pos1 >> b) > 0).astype(jnp.int32)
+
+    # ancestor chain root->parent(pos): q_k = ((pos+1) >> (e-k)) - 1, k < e
+    ks = jnp.arange(L, dtype=jnp.int32)
+    shift = jnp.clip(e - ks, 0, 31)
+    q = (pos1 >> shift) - 1  # [L]; q_e == pos for k == e
+    valid = ks < e
+    vt, vr, vk, vp = _gather(h, q)
+
+    # insertion depth: ancestors with key <= newitem stay above it
+    s = jnp.sum((valid & ~_less(xt, xr, vt, vr)).astype(jnp.int32))
+
+    # ancestors at depth k in [s, e) move down to q_{k+1}; newitem -> q_s.
+    # q_{k+1} = 2*q_k + 1 + (child parity of the path), but simpler: the
+    # chain is q itself shifted, and q_{k+1} for k<e is exactly q[k+1]
+    # (q has L entries; k+1 <= e <= L-1).
+    q_next = jnp.concatenate([q[1:], jnp.full((1,), cap, jnp.int32)])
+    move = valid & (ks >= s) & pred
+    tgt = jnp.where(move, q_next, cap)  # drop when not moving
+    x_tgt = jnp.where(pred, q[jnp.minimum(s, L - 1)], cap)
+
+    idx = jnp.concatenate([tgt, x_tgt[None]])
+    t_all = jnp.concatenate([vt, xt[None]])
+    r_all = jnp.concatenate([vr, xr[None]])
+    k_all = jnp.concatenate([vk, xk[None]])
+    p_all = jnp.concatenate([vp, xp[None]])
+    new_size = h.size + pred.astype(jnp.int32)
+    return _scatter(h, idx, t_all, r_all, k_all, p_all, new_size)
 
 
-def heap_pop(h: EventHeap):
-    """heapq.heappop. Caller must ensure size > 0. Returns (heap, item)."""
-    item = _get(h, 0)
-    newsize = h.size - 1
-    last = _get(h, newsize)
-    # when newsize == 0 the sift degenerates to writing last back to slot 0,
-    # which equals the popped item -- harmless, matching heapq's early return.
-    h = _siftup(h._replace(size=newsize), jnp.int32(0), last, newsize)
-    return h, item
+def heap_pop(h: EventHeap, pred=True):
+    """``heapq.heappop``; no-op (garbage item) when ``pred`` is False.
+
+    CPython's pop moves the last element into the root hole and runs
+    ``_siftup``: descend the smaller-child path all the way to a leaf,
+    shifting each child up one level, then ``_siftdown`` the moved item
+    back up that path. Net effect: insert the last element into the sorted
+    root-to-leaf smaller-child chain -- items above its insertion depth
+    shift up one level, items below stay put. The descent carries only a
+    scalar position (unrolled, fixed depth); the mutation is one scatter.
+
+    Caller must ensure size > 0 when ``pred`` holds. Returns (heap, item).
+    """
+    L = h.levels
+    cap = jnp.int32(h.capacity)
+    item = _gather(h, jnp.int32(0))
+    newsize = jnp.maximum(h.size - 1, 0)
+    xt, xr, xk, xp = _gather(h, newsize)  # relocated last element
+
+    # smaller-child descent from the root among live slots [0, newsize)
+    qs, vts, vrs, vks, vps, alive_ks = [], [], [], [], [], []
+    pos = jnp.int32(0)
+    alive = jnp.bool_(True)
+    for _ in range(1, L):
+        child = 2 * pos + 1
+        right = child + 1
+        ct, cr, ck, cp = _gather(h, child)
+        rt, rr, rk, rp = _gather(h, right)
+        use_right = (right < newsize) & ~_less(ct, cr, rt, rr)
+        cpos = jnp.where(use_right, right, child)
+        alive = alive & (child < newsize)
+        vt = jnp.where(use_right, rt, ct)
+        vr = jnp.where(use_right, rr, cr)
+        vk = jnp.where(use_right, rk, ck)
+        vp = jnp.where(use_right, rp, cp)
+        qs.append(cpos)
+        vts.append(vt)
+        vrs.append(vr)
+        vks.append(vk)
+        vps.append(vp)
+        alive_ks.append(alive)
+        pos = jnp.where(alive, cpos, pos)
+
+    q = jnp.stack(qs)  # [L-1] path slots q_1..q_{L-1}
+    vt = jnp.stack(vts)
+    vr = jnp.stack(vrs)
+    vk = jnp.stack(vks)
+    vp = jnp.stack(vps)
+    valid = jnp.stack(alive_ks)  # k <= d (live path levels)
+
+    # insertion depth s = #{live v_k <= x}; chain ascending => suffix moves
+    s = jnp.sum((valid & ~_less(xt, xr, vt, vr)).astype(jnp.int32))
+
+    # v_k for k in [1, s] shift up to q_{k-1}; x -> q_s (q_0 = root slot 0)
+    ks = 1 + jnp.arange(L - 1, dtype=jnp.int32)
+    q_prev = jnp.concatenate([jnp.zeros((1,), jnp.int32), q[:-1]])
+    pred = jnp.asarray(pred, bool)
+    move = valid & (ks <= s) & pred
+    tgt = jnp.where(move, q_prev, cap)
+    x_tgt = jnp.where(
+        pred, jnp.where(s > 0, q[jnp.clip(s - 1, 0, L - 2)], 0), cap)
+
+    idx = jnp.concatenate([tgt, x_tgt[None]])
+    t_all = jnp.concatenate([vt, xt[None]])
+    r_all = jnp.concatenate([vr, xr[None]])
+    k_all = jnp.concatenate([vk, xk[None]])
+    p_all = jnp.concatenate([vp, xp[None]])
+    new_size = jnp.where(pred, newsize, h.size)
+    h2 = _scatter(h, idx, t_all, r_all, k_all, p_all, new_size)
+    return h2, item
 
 
 def first_deletion_in_array_order(h: EventHeap):

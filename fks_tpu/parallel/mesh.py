@@ -30,8 +30,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from fks_tpu.data.entities import Workload
 from fks_tpu.models import parametric
-from fks_tpu.parallel.population import ParamPolicyFn, make_single_run
-from fks_tpu.sim.engine import SimConfig, initial_state
+from fks_tpu.parallel.population import ParamPolicyFn
+from fks_tpu.sim.engine import SimConfig, initial_state, make_population_run_fn
 
 POP_AXIS = "pop"
 
@@ -70,10 +70,9 @@ def _shard_params(params: jax.Array, mesh: Mesh) -> jax.Array:
 
 
 def _global_scores(run, state0, params_shard):
-    """Per-shard vmapped fitness + the ICI all-gather of the full population
+    """Per-shard batched fitness + the ICI all-gather of the full population
     fitness vector (shared preamble of eval and generation-step)."""
-    local_scores = jax.vmap(
-        lambda p: run(p, state0).policy_score)(params_shard)
+    local_scores = run(params_shard, state0).policy_score
     return local_scores, jax.lax.all_gather(local_scores, POP_AXIS, tiled=True)
 
 
@@ -116,7 +115,7 @@ def make_sharded_eval(workload: Workload, mesh: Mesh,
     set used for parent sampling and truncation (reference semantics: sort
     desc + take elite_size, funsearch_integration.py:494-496).
     """
-    run = make_single_run(workload, param_policy, cfg)
+    run = make_population_run_fn(workload, param_policy, cfg)
     state0 = initial_state(workload, cfg)
 
     @functools.partial(
@@ -156,7 +155,7 @@ def make_sharded_generation_step(workload: Workload, mesh: Mesh,
     ``pop``. Forward ``pad_population``'s ``real_count`` so pad duplicates
     never win elite slots.
     """
-    run = make_single_run(workload, param_policy, cfg)
+    run = make_population_run_fn(workload, param_policy, cfg)
     state0 = initial_state(workload, cfg)
 
     @functools.partial(

@@ -10,7 +10,10 @@ and the event loop runs for all candidates in lockstep under ``vmap``.
 
 Two candidate representations are supported:
 - **parametric** (this module's fast path): candidate = weight vector,
-  population = ``params[C, F]``, evaluated by a single vmapped while_loop.
+  population = ``params[C, F]``, evaluated by the vmapped self-masking
+  step inside ONE while_loop (engine.make_population_run_fn — not
+  ``vmap(while_loop)``, which would full-carry-select every lane each
+  event to freeze finished candidates).
 - **compiled code** (general path): candidates from the LLM transpiler are
   distinct computations; they batch by Python loop over per-code jitted runs
   with an AST-keyed compile cache (fks_tpu.funsearch.backend).
@@ -23,7 +26,9 @@ import jax
 
 from fks_tpu.data.entities import Workload
 from fks_tpu.models import parametric
-from fks_tpu.sim.engine import SimConfig, initial_state, make_param_run_fn
+from fks_tpu.sim.engine import (
+    SimConfig, initial_state, make_param_run_fn, make_population_run_fn,
+)
 from fks_tpu.sim.types import NodeView, PodView, SimResult
 
 # A parameterized policy: (params, PodView, NodeView) -> i32[N] scores.
@@ -41,15 +46,15 @@ def make_population_eval(workload: Workload,
     """Build ``eval(params[C, ...]) -> SimResult`` batched over candidates.
 
     The reference's per-candidate subprocess fan-out collapsed into one
-    ``vmap``; the while_loop batching rule keeps all candidates stepping
-    until the slowest finishes (per-candidate step counts differ only via
-    retries, which are rare on the shipped traces).
+    compiled program: all candidates advance in lockstep through the
+    while_loop; a candidate that finishes early (fewer retries) idles as
+    dropped scatters until the slowest lane drains its heap.
     """
-    run = make_single_run(workload, param_policy, cfg)
+    run = make_population_run_fn(workload, param_policy, cfg)
     state0 = initial_state(workload, cfg)
 
     def population_eval(params):
-        return jax.vmap(lambda p: run(p, state0))(params)
+        return run(params, state0)
 
     return jax.jit(population_eval) if jit else population_eval
 

@@ -24,9 +24,11 @@ import numpy as np
 from fks_tpu.data.entities import ClusterArrays, PodArrays, Workload
 from fks_tpu.models import parametric
 from fks_tpu.parallel.population import ParamPolicyFn
-from fks_tpu.sim.engine import SimConfig, build_step, finalize, initial_state
+from fks_tpu.sim.engine import (
+    SimConfig, broadcast_state, build_step, finalize, initial_state,
+    run_batched_lanes,
+)
 from fks_tpu.sim.evaluator import max_snapshot_count, snapshot_trigger_table
-from fks_tpu.sim.types import SimState
 
 
 def _strip_ids(wl: Workload) -> Workload:
@@ -87,25 +89,6 @@ def stack_traces(workloads: Sequence[Workload], cfg: SimConfig):
     return stacked_wl, jnp.asarray(kt), stacked_state, max_steps
 
 
-def make_trace_run(cfg: SimConfig, max_steps: int,
-                   param_policy: ParamPolicyFn = parametric.score):
-    """``run(workload, ktable, params, state) -> SimResult`` with the
-    workload as a TRACED argument (one compilation per shape, not per
-    trace). ``max_steps`` must be static: it bounds the while_loop."""
-
-    def cond(s: SimState):
-        return (s.heap.size > 0) & ~s.failed & (s.steps < max_steps)
-
-    def run(workload, ktable, params, state):
-        step = build_step(
-            workload, lambda pod, nodes: param_policy(params, pod, nodes),
-            cfg, ktable)
-        final = jax.lax.while_loop(cond, step, state)
-        return finalize(workload, cfg, final)
-
-    return run
-
-
 def make_trace_batch_eval(workloads: Sequence[Workload],
                           param_policy: ParamPolicyFn = parametric.score,
                           cfg: SimConfig = SimConfig(),
@@ -115,18 +98,41 @@ def make_trace_batch_eval(workloads: Sequence[Workload],
 
     ``population=False``: params is one candidate, results have leading
     axis [T]. ``population=True``: params[C, ...] adds an outer candidate
-    vmap -> results [C, T] (fitness of every candidate on every trace from
+    axis -> results [C, T] (fitness of every candidate on every trace from
     one program — the full config-4 matrix).
+
+    Loop scaffold: the engine's ``run_batched_lanes`` over the
+    (nested-)vmapped self-masking step, with the workload itself a traced
+    vmap argument so one compiled program serves every same-shape trace.
     """
     wl, kt, state0, max_steps = stack_traces(workloads, cfg)
-    run = make_trace_run(cfg, max_steps, param_policy)
 
-    def eval_traces(params):
-        per_trace = jax.vmap(lambda w, k, s: run(w, k, params, s))
-        return per_trace(wl, kt, state0)
+    def step_one(workload, ktable, params, s):
+        return build_step(
+            workload, lambda pod, nodes: param_policy(params, pod, nodes),
+            cfg, ktable, max_steps)(s)
+
+    fin = lambda w, s: finalize(w, cfg, s)  # noqa: E731
 
     if population:
-        fn = jax.vmap(eval_traces)
+        # lanes [C, T]: traces inner, candidates outer
+        vstep = jax.vmap(jax.vmap(step_one, in_axes=(0, 0, None, 0)),
+                         in_axes=(None, None, 0, 0))
+        vfin = jax.vmap(jax.vmap(fin, in_axes=(0, 0)), in_axes=(None, 0))
+
+        def eval_fn(params):
+            pop = jax.tree_util.tree_leaves(params)[0].shape[0]
+            final = run_batched_lanes(
+                lambda s: vstep(wl, kt, params, s),
+                broadcast_state(state0, pop), max_steps)
+            return vfin(wl, final)
     else:
-        fn = eval_traces
-    return jax.jit(fn) if jit else fn
+        vstep = jax.vmap(step_one, in_axes=(0, 0, None, 0))
+        vfin = jax.vmap(fin, in_axes=(0, 0))
+
+        def eval_fn(params):
+            final = run_batched_lanes(
+                lambda s: vstep(wl, kt, params, s), state0, max_steps)
+            return vfin(wl, final)
+
+    return jax.jit(eval_fn) if jit else eval_fn

@@ -112,14 +112,31 @@ def _node_view(c: ClusterArrays, cpu_left, mem_left, gpu_left, gpu_milli_left):
     )
 
 
+def lane_active(s: SimState, max_steps: int):
+    """THE termination predicate: a lane keeps stepping while events remain,
+    no GPU-allocation abort happened, and the runaway guard holds. Single
+    source of truth for both the step's self-masking and every loop cond —
+    if they ever diverged, a loop whose cond is any(lane_active) over
+    no-op'ing lanes would spin forever."""
+    return (s.heap.size > 0) & ~s.failed & (s.steps < max_steps)
+
+
 def build_step(workload: Workload, policy: PolicyFn, cfg: SimConfig,
-               ktable) -> Callable[[SimState], SimState]:
+               ktable, max_steps: int) -> Callable[[SimState], SimState]:
     """One event: the body of the while_loop. See module docstring.
 
     ``workload`` arrays and ``ktable`` may be tracers (the multi-trace path
     passes them as jit/vmap arguments so one compiled program serves every
     same-shape trace); all totals are therefore computed with jnp ops, which
-    XLA constant-folds when the workload is a compile-time constant."""
+    XLA constant-folds when the workload is a compile-time constant.
+
+    The step is *self-masking*: it computes its own ``active`` predicate
+    (same condition as the loop guard) and becomes a no-op when inactive --
+    every mutation is either a dropped scatter or a predicate-gated add.
+    That lets the population layer run ONE ``while_loop`` whose body is the
+    vmapped step and whose cond is ``any(active)``: finished lanes idle for
+    O(log n) dropped scatters instead of the full-carry per-lane select that
+    ``vmap(while_loop)`` would insert every iteration."""
     c, p = workload.cluster, workload.pods
     # device-resident copies (parser emits numpy; tracers can't index numpy)
     c = jax.tree_util.tree_map(jnp.asarray, c)
@@ -138,9 +155,10 @@ def build_step(workload: Workload, policy: PolicyFn, cfg: SimConfig,
     klen = ktable.shape[0]
 
     def step(s: SimState) -> SimState:
-        h, (t, rk, kind, pod) = heap_pop(s.heap)
-        is_del = kind == jnp.int8(KIND_DELETE)
-        create = ~is_del
+        active = lane_active(s, max_steps)
+        h, (t, rk, kind, pod) = heap_pop(s.heap, pred=active)
+        is_del = active & (kind == jnp.int8(KIND_DELETE))
+        create = active & ~(kind == jnp.int8(KIND_DELETE))
 
         pcpu = p.cpu[pod]
         pmem = p.mem[pod]
@@ -216,7 +234,7 @@ def build_step(workload: Workload, policy: PolicyFn, cfg: SimConfig,
 
         # ---- evaluator bookkeeping (main.py:63-72, evaluator.py:55-67).
         # On alloc_fail the reference raises BEFORE record_event_processed.
-        valid = ~alloc_fail
+        valid = active & ~alloc_fail
         events = s.events_processed + valid.astype(jnp.int32)
         fire = valid & (s.snap_idx < klen) & (
             events >= ktable[jnp.minimum(s.snap_idx, klen - 1)])
@@ -232,14 +250,14 @@ def build_step(workload: Workload, policy: PolicyFn, cfg: SimConfig,
         snap_sums = s.snap_sums + jnp.where(fire, utils, 0)
         snap_idx = s.snap_idx + fire.astype(jnp.int32)
 
-        active = jnp.sum((c.node_mask & (
+        active_nodes = jnp.sum((c.node_mask & (
             (cpu_left < c.cpu_total) | (mem_left < c.mem_total)
             | (gpu_left < c.num_gpus))), dtype=jnp.int32)
-        max_nodes = jnp.maximum(s.max_nodes, jnp.where(valid, active, 0))
+        max_nodes = jnp.maximum(s.max_nodes, jnp.where(valid, active_nodes, 0))
 
         violations = s.violations
         if cfg.validate_invariants:
-            violations = violations + _audit(
+            violations = violations + active.astype(jnp.int32) * _audit(
                 c, p, heap3, cpu_left, mem_left, gpu_left, gpu_milli_left,
                 assigned_node, assigned_gpus)
 
@@ -250,7 +268,7 @@ def build_step(workload: Workload, policy: PolicyFn, cfg: SimConfig,
             pod_ctime=pod_ctime, waiting=waiting, wait_hist=hist,
             events_processed=events, snap_idx=snap_idx, snap_sums=snap_sums,
             frag_sum=frag_sum, frag_count=frag_count, max_nodes=max_nodes,
-            failed=s.failed | alloc_fail, steps=s.steps + 1,
+            failed=s.failed | alloc_fail, steps=s.steps + active.astype(jnp.int32),
             violations=violations,
         )
 
@@ -347,28 +365,84 @@ def make_param_run_fn(workload: Workload, param_policy, cfg: SimConfig = SimConf
     """Build ``run(params, state) -> SimResult`` for a parameterized policy
     ``(params, PodView, NodeView) -> i32[N]``.
 
-    This is the single loop-assembly point (ktable sizing, termination
-    predicate, while_loop + finalize) shared by the plain path below and the
-    population/mesh layers (fks_tpu.parallel), so fitness semantics cannot
-    diverge between them. ``params`` may be a tracer: the step closure is
-    rebuilt under the caller's trace, which is what lets ``vmap`` add the
-    population axis outside.
+    Single-lane loop assembly: ``loop_tables`` sizing + ``lane_active``
+    cond + while_loop + finalize. Batched paths (population/trace-batch/
+    mesh) share the same pieces via ``make_population_run_fn`` /
+    ``run_batched_lanes``, so fitness semantics cannot diverge between
+    them. ``params`` may be a tracer: the step closure is rebuilt under
+    the caller's trace.
     """
+    ktable, max_steps = loop_tables(workload, cfg)
+
+    def cond(s: SimState):
+        return lane_active(s, max_steps)
+
+    def run(params, state: SimState) -> SimResult:
+        step = build_step(
+            workload, lambda pod, nodes: param_policy(params, pod, nodes),
+            cfg, ktable, max_steps)
+        final = jax.lax.while_loop(cond, step, state)
+        return finalize(workload, cfg, final)
+
+    return run
+
+
+def loop_tables(workload: Workload, cfg: SimConfig):
+    """(ktable, max_steps) for a workload — the static loop-sizing half of
+    loop assembly, shared by every runner so snapshot semantics can't
+    diverge between the plain, population, trace-batch, and mesh paths."""
     num_pods = workload.num_pods
     max_steps = cfg.resolve_max_steps(num_pods)
     ktable = snapshot_trigger_table(
         num_pods, max_snapshot_count(max_steps, num_pods, cfg.snapshot_interval),
         cfg.snapshot_interval)
+    return ktable, max_steps
 
-    def cond(s: SimState):
-        return (s.heap.size > 0) & ~s.failed & (s.steps < max_steps)
 
-    def run(params, state: SimState) -> SimResult:
-        step = build_step(
-            workload, lambda pod, nodes: param_policy(params, pod, nodes),
-            cfg, ktable)
-        final = jax.lax.while_loop(cond, step, state)
-        return finalize(workload, cfg, final)
+def broadcast_state(state0: SimState, lanes: int) -> SimState:
+    """Broadcast one initial state to ``lanes`` identical device-resident
+    copies (vs. the reference's per-subprocess re-parse + deepcopy,
+    funsearch_integration.py:38-48)."""
+    return jax.tree_util.tree_map(
+        lambda x: jnp.broadcast_to(jnp.asarray(x), (lanes,) + jnp.shape(x)),
+        state0)
+
+
+def run_batched_lanes(vstep, bstate: SimState, max_steps: int) -> SimState:
+    """Drive any stack of self-masking lanes to completion.
+
+    NOT ``vmap(while_loop)``: that would select the entire per-lane carry
+    (heap arrays included) every iteration to freeze finished lanes.
+    Instead the vmapped self-masking step runs INSIDE one ``while_loop``
+    whose cond is "any lane active", so a finished lane costs only dropped
+    scatters. ``vstep`` must wrap ``build_step`` lanes (any nesting of
+    vmaps); the cond reuses the exact ``lane_active`` predicate the step
+    masks with."""
+    return jax.lax.while_loop(
+        lambda s: jnp.any(lane_active(s, max_steps)), vstep, bstate)
+
+
+def make_population_run_fn(workload: Workload, param_policy,
+                           cfg: SimConfig = SimConfig()):
+    """Build ``run(params[C, ...], state0) -> SimResult`` batched over the
+    candidate axis — the TPU-native replacement for the reference's
+    per-candidate subprocess fan-out (funsearch_integration.py:535-562).
+    Loop scaffold: ``run_batched_lanes`` over the vmapped self-masking step.
+    """
+    ktable, max_steps = loop_tables(workload, cfg)
+
+    def run(params, state0: SimState) -> SimResult:
+        pop = jax.tree_util.tree_leaves(params)[0].shape[0]
+
+        def step_one(p, s):
+            return build_step(
+                workload, lambda pod, nodes: param_policy(p, pod, nodes),
+                cfg, ktable, max_steps)(s)
+
+        vstep = jax.vmap(step_one, in_axes=(0, 0))
+        final = run_batched_lanes(
+            lambda s: vstep(params, s), broadcast_state(state0, pop), max_steps)
+        return jax.vmap(lambda s: finalize(workload, cfg, s))(final)
 
     return run
 
