@@ -77,16 +77,18 @@ def main():
         f"scores [{float(jnp.min(res.policy_score)):.3f}, "
         f"{float(jnp.max(res.policy_score)):.3f}]")
 
+    from fks_tpu.utils import ThroughputMeter, block_timed
+
+    meter = ThroughputMeter()
     times = []
     for _ in range(reps):
-        t0 = time.perf_counter()
-        res = ev(params)
-        jax.block_until_ready(res.policy_score)
-        times.append(time.perf_counter() - t0)
+        _, secs = block_timed(ev, params)
+        times.append(secs)
+        meter.add(pop_size, secs)
     best = min(times)
     evals_per_sec = pop_size / best
-    log(f"steady-state: {best:.3f}s / {pop_size} evals "
-        f"(all reps: {[round(t, 3) for t in times]})")
+    log(f"steady-state: {best:.3f}s / {pop_size} evals; aggregate "
+        f"{meter.summary()} (all reps: {[round(t, 3) for t in times]})")
 
     print(json.dumps({
         "metric": "candidate policy evaluations/sec (8152-pod trace)",

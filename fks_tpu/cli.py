@@ -22,11 +22,23 @@ import sys
 import time
 
 
-def _maybe_x64(args):
-    if getattr(args, "f64", False):
-        import jax
+def _apply_platform_flags(args):
+    import jax
 
+    if getattr(args, "cpu", False):
+        # jax.config, not JAX_PLATFORMS env: the env route hangs when the
+        # TPU tunnel is wedged (see .claude/skills/verify/SKILL.md)
+        jax.config.update("jax_platforms", "cpu")
+    if getattr(args, "f64", False):
         jax.config.update("jax_enable_x64", True)
+
+
+def _metrics_writer(args):
+    if getattr(args, "metrics", ""):
+        from fks_tpu.utils import MetricsWriter
+
+        return MetricsWriter(args.metrics)
+    return None
 
 
 def _parse_workload(args):
@@ -78,13 +90,14 @@ def _print_table(rows):
 def cmd_bench(args):
     """The reference benchmark table (test_scheduler.py:287-331): every
     requested policy against the workload, jit-compiled, with wall time."""
-    _maybe_x64(args)
+    _apply_platform_flags(args)
     import jax.numpy as jnp
 
     from fks_tpu.models import zoo
     from fks_tpu.sim.engine import SimConfig, simulate
 
     _, wl = _parse_workload(args)
+    metrics = _metrics_writer(args)
     names = (args.policies.split(",") if args.policies else list(zoo.ZOO))
     dtype = jnp.float64 if args.f64 else jnp.float32
     cfg = SimConfig(score_dtype=dtype, validate_invariants=args.validate)
@@ -99,10 +112,18 @@ def cmd_bench(args):
         t0 = time.time()
         res = simulate(wl, zoo.ZOO[name](dtype=dtype), cfg)
         res.policy_score.block_until_ready()
-        rows.append(_result_row(name, res, time.time() - t0))
+        wall = time.time() - t0
+        rows.append(_result_row(name, res, wall))
+        if metrics:
+            from fks_tpu.utils import result_record
+
+            metrics.write("bench", result_record(res), policy=name,
+                          wall_s=wall, trace=args.trace, nodes=args.nodes)
         if args.validate and int(res.invariant_violations):
             print(f"WARNING: {name}: {int(res.invariant_violations)} "
                   "invariant violations", file=sys.stderr)
+    if metrics:
+        metrics.close()
     _print_table(rows)
     return 0
 
@@ -110,7 +131,7 @@ def cmd_bench(args):
 def cmd_simulate(args):
     """Single policy, detailed output (reference: tests/test_integration.py
     style summary)."""
-    _maybe_x64(args)
+    _apply_platform_flags(args)
     import jax.numpy as jnp
     import numpy as np
 
@@ -118,6 +139,7 @@ def cmd_simulate(args):
     from fks_tpu.sim.engine import SimConfig, simulate
 
     _, wl = _parse_workload(args)
+    metrics = _metrics_writer(args)  # up front: bad paths fail fast
     dtype = jnp.float64 if args.f64 else jnp.float32
     cfg = SimConfig(score_dtype=dtype, validate_invariants=args.validate)
     t0 = time.time()
@@ -133,6 +155,12 @@ def cmd_simulate(args):
         "failed": bool(res.failed), "truncated": bool(res.truncated),
         "invariant_violations": int(res.invariant_violations),
     })
+    if metrics:
+        from fks_tpu.utils import result_record
+
+        metrics.write("simulate", result_record(res), policy=args.policy,
+                      wall_s=wall, trace=args.trace, nodes=args.nodes)
+        metrics.close()
     print(json.dumps(out, indent=2))
     return 0
 
@@ -153,9 +181,21 @@ def cmd_evolve(args):
         print("no API key in config; use --fake-llm for hermetic runs",
               file=sys.stderr)
         return 2
+    _apply_platform_flags(args)
     _, wl = _parse_workload(args)
+    metrics = _metrics_writer(args)
+    on_gen = None
+    if metrics:
+        import dataclasses
+
+        def on_gen(st):
+            # streamed per generation: an interrupted evolution still
+            # leaves a complete metric trail up to the crash point
+            metrics.write("generation", dataclasses.asdict(st))
     fs = evo.run(wl, cfg, backend=backend, sim_config=SimConfig(),
-                 checkpoint_path=args.checkpoint)
+                 checkpoint_path=args.checkpoint, on_generation=on_gen)
+    if metrics:
+        metrics.close()
     if fs.best:
         print(f"best fitness: {fs.best[1]:.4f}")
         if args.out:
@@ -181,8 +221,13 @@ def cmd_traces(args):
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="fks_tpu", description=__doc__)
     sub = ap.add_subparsers(dest="cmd", required=True)
+    common = argparse.ArgumentParser(add_help=False)
+    common.add_argument("--cpu", action="store_true",
+                        help="force the CPU backend (skip the TPU tunnel)")
+    common.add_argument("--metrics", default="",
+                        help="append JSONL metric records to this file")
 
-    b = sub.add_parser("bench", help="policy comparison table")
+    b = sub.add_parser("bench", help="policy comparison table", parents=[common])
     _add_trace_flags(b)
     b.add_argument("--policies", default="",
                    help="comma-separated zoo policy names (default: all)")
@@ -192,14 +237,14 @@ def main(argv=None) -> int:
                    help="enable the per-event invariant audit")
     b.set_defaults(fn=cmd_bench)
 
-    s = sub.add_parser("simulate", help="one policy, detailed JSON result")
+    s = sub.add_parser("simulate", help="one policy, detailed JSON result", parents=[common])
     _add_trace_flags(s)
     s.add_argument("--policy", default="best_fit")
     s.add_argument("--f64", action="store_true")
     s.add_argument("--validate", action="store_true")
     s.set_defaults(fn=cmd_simulate)
 
-    e = sub.add_parser("evolve", help="run FunSearch evolution")
+    e = sub.add_parser("evolve", help="run FunSearch evolution", parents=[common])
     _add_trace_flags(e)
     e.add_argument("--config", default="", help="reference-format llm_config.json")
     e.add_argument("--fake-llm", action="store_true",

@@ -28,6 +28,7 @@ from typing import Callable, List, Optional, Sequence, Tuple
 
 from fks_tpu.funsearch import llm as llm_mod
 from fks_tpu.funsearch import template
+from fks_tpu.utils import profiling
 from fks_tpu.funsearch.backend import CodeEvaluator
 from fks_tpu.sim.engine import SimConfig
 
@@ -110,7 +111,9 @@ class FunSearch:
     def __init__(self, evaluator: CodeEvaluator,
                  config: EvolutionConfig = EvolutionConfig(),
                  backend: Optional[llm_mod.TextBackend] = None,
-                 log: Callable[[str], None] = print):
+                 log: Callable[[str], None] = print,
+                 on_generation: Optional[
+                     Callable[["GenerationStats"], None]] = None):
         self.cfg = config
         self.evaluator = evaluator
         self.rng = random.Random(config.seed)
@@ -123,6 +126,7 @@ class FunSearch:
             else:
                 backend = llm_mod.FakeLLM(seed=config.seed)
         self.generator = llm_mod.CandidateGenerator(backend)
+        self.on_generation = on_generation
         self.population: List[Member] = []
         self.generation = 0
         self.best: Optional[Member] = None
@@ -188,9 +192,7 @@ class FunSearch:
             self.generator, n_new, self._sample_parents, feedback,
             cfg.max_workers)
 
-        t0 = time.time()
-        records = self.evaluator.evaluate(codes)
-        eval_s = time.time() - t0
+        records, eval_s = profiling.block_timed(self.evaluator.evaluate, codes)
 
         accepted = rejected = 0
         for r in records:
@@ -213,6 +215,10 @@ class FunSearch:
             rejected_similar=rejected, eval_seconds=eval_s,
             compile_count=self.evaluator.compile_count)
         self.history.append(stats)
+        if self.on_generation is not None:
+            # streamed per generation so an interrupted run still leaves a
+            # complete metric trail (fks_tpu.utils.logging contract)
+            self.on_generation(stats)
         self.log(
             f"gen {stats.generation}: best {stats.best_score:.4f} "
             f"mean {stats.mean_score:.4f} new {stats.new_candidates} "
@@ -306,11 +312,14 @@ def run(workload, config: Optional[EvolutionConfig] = None,
         backend: Optional[llm_mod.TextBackend] = None,
         sim_config: SimConfig = SimConfig(),
         checkpoint_path: Optional[str] = None,
-        log: Callable[[str], None] = print) -> FunSearch:
+        log: Callable[[str], None] = print,
+        on_generation: Optional[Callable[[GenerationStats], None]] = None,
+        ) -> FunSearch:
     """Assemble evaluator + driver, optionally resuming from a checkpoint,
     and run to completion. Returns the driver for inspection."""
     fs = FunSearch(CodeEvaluator(workload, sim_config),
-                   config or EvolutionConfig(), backend, log)
+                   config or EvolutionConfig(), backend, log,
+                   on_generation=on_generation)
     if checkpoint_path and os.path.exists(checkpoint_path):
         fs.restore(checkpoint_path)
         log(f"resumed from {checkpoint_path} at generation {fs.generation}")

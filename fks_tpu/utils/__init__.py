@@ -1,0 +1,14 @@
+"""Observability utilities: profiling, logging, JSONL metrics.
+
+The reference has neither profiler hooks nor ``logging`` (SURVEY.md §5);
+these are framework additions with a reference-compatible metric schema.
+"""
+from fks_tpu.utils.logging import MetricsWriter, get_logger, result_record
+from fks_tpu.utils.profiling import (
+    ThroughputMeter, Timing, block_timed, device_trace, timed,
+)
+
+__all__ = [
+    "MetricsWriter", "get_logger", "result_record",
+    "ThroughputMeter", "Timing", "block_timed", "device_trace", "timed",
+]

@@ -1,0 +1,107 @@
+"""Structured logging + JSONL metrics.
+
+The reference has no ``logging`` at all — every diagnostic is a bare
+``print`` (SURVEY.md §5, grep-verified against the reference). This module
+gives the framework a real observability spine without changing the
+reference-parity output surfaces (the CLI still prints its tables):
+
+- ``get_logger``: namespaced stdlib loggers under ``fks_tpu``, configured
+  once, level from ``FKS_LOG_LEVEL`` (default INFO).
+- ``MetricsWriter``: append-only JSONL records. The schema for simulation
+  results mirrors the metric set the reference reports per run —
+  ``EvaluationResults`` + policy_score + scheduled_pods + simulation_time +
+  max_nodes (reference: simulator/evaluator.py:16-25, main.py:42,67-72,
+  tests/test_scheduler.py:304-331) — so downstream tooling can consume
+  either framework's numbers.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import time
+from typing import IO, Any, Dict, Optional
+
+_CONFIGURED = False
+
+
+def get_logger(name: str = "fks_tpu") -> logging.Logger:
+    """Namespaced logger; configures the ``fks_tpu`` root exactly once."""
+    global _CONFIGURED
+    root = logging.getLogger("fks_tpu")
+    if not _CONFIGURED:
+        level = os.environ.get("FKS_LOG_LEVEL", "INFO").upper()
+        root.setLevel(getattr(logging, level, logging.INFO))
+        if not root.handlers:
+            h = logging.StreamHandler()
+            h.setFormatter(logging.Formatter(
+                "%(asctime)s %(name)s %(levelname).1s %(message)s",
+                datefmt="%H:%M:%S"))
+            root.addHandler(h)
+        root.propagate = False
+        _CONFIGURED = True
+    if name == "fks_tpu" or name.startswith("fks_tpu."):
+        return logging.getLogger(name)
+    return logging.getLogger(f"fks_tpu.{name}")
+
+
+def result_record(result, **extra) -> Dict[str, Any]:
+    """Flatten a ``SimResult`` into the reference-compatible metric schema
+    (plain floats/ints, JSON-ready)."""
+    rec = {
+        "policy_score": float(result.policy_score),
+        "avg_cpu_utilization": float(result.avg_cpu_utilization),
+        "avg_memory_utilization": float(result.avg_memory_utilization),
+        "avg_gpu_count_utilization": float(result.avg_gpu_count_utilization),
+        "avg_gpu_memory_utilization": float(result.avg_gpu_memory_utilization),
+        "gpu_fragmentation_score": float(result.gpu_fragmentation_score),
+        "num_snapshots": int(result.num_snapshots),
+        "num_fragmentation_events": int(result.num_fragmentation_events),
+        "events_processed": int(result.events_processed),
+        "scheduled_pods": int(result.scheduled_pods),
+        "max_nodes": int(result.max_nodes),
+        "failed": bool(result.failed),
+        "truncated": bool(result.truncated),
+    }
+    rec.update(extra)
+    return rec
+
+
+class MetricsWriter:
+    """Append JSON lines (one record per event) to a file or stream.
+
+    Each record gets a ``ts`` wall-clock field. Writes are flushed per
+    record so an interrupted run (the reference loses everything on crash
+    except champion JSONs, SURVEY.md §5 checkpoint note) still leaves a
+    complete metric trail.
+    """
+
+    def __init__(self, path_or_stream):
+        if isinstance(path_or_stream, (str, os.PathLike)):
+            parent = os.path.dirname(os.fspath(path_or_stream))
+            if parent:
+                os.makedirs(parent, exist_ok=True)
+            self._f: IO[str] = open(path_or_stream, "a")
+            self._owns = True
+        else:
+            self._f = path_or_stream
+            self._owns = False
+
+    def write(self, kind: str, record: Optional[Dict[str, Any]] = None,
+              **fields) -> None:
+        rec = {"ts": time.time(), "kind": kind}
+        if record:
+            rec.update(record)
+        rec.update(fields)
+        self._f.write(json.dumps(rec) + "\n")
+        self._f.flush()
+
+    def close(self) -> None:
+        if self._owns:
+            self._f.close()
+
+    def __enter__(self) -> "MetricsWriter":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

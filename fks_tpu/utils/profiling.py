@@ -1,0 +1,110 @@
+"""Profiling hooks: wall-clock timing that respects async dispatch, JAX
+device tracing, and throughput counters.
+
+The reference's only instrumentation is ad-hoc ``time.time()`` deltas around
+runs (reference: tests/test_scheduler.py:266-269, test_integration.py:130-137,
+funsearch/funsearch_integration.py:586-589) — no profiler hooks at all
+(SURVEY.md §5). Here timing is a first-class utility that (a) blocks on the
+actual device result before stopping the clock (JAX dispatch is async; a
+naive delta measures enqueue time, not compute), and (b) can capture a real
+XLA profile for TensorBoard/xprof when a hotspot needs the instruction-level
+view.
+"""
+from __future__ import annotations
+
+import contextlib
+import time
+from dataclasses import dataclass, field
+from typing import Any, Iterator, List, Optional
+
+import jax
+
+
+@dataclass
+class Timing:
+    """Result of a ``timed`` block. ``seconds`` is valid after the block."""
+
+    label: str = ""
+    seconds: float = 0.0
+
+
+@contextlib.contextmanager
+def timed(label: str = "", sync: Any = None) -> Iterator[Timing]:
+    """Measure a block's wall time; if ``sync`` is given (any pytree of
+    jax arrays) block until those values are actually materialized on
+    device before stopping the clock.
+
+    >>> with timed("eval", sync=result) as t: ...
+    >>> t.seconds
+    """
+    out = Timing(label=label)
+    t0 = time.perf_counter()
+    try:
+        yield out
+    finally:
+        if sync is not None:
+            jax.block_until_ready(sync)
+        out.seconds = time.perf_counter() - t0
+
+
+def block_timed(fn, *args, **kwargs):
+    """Call ``fn`` and return (result, seconds) with the result fully
+    materialized — the one-liner version of ``timed``."""
+    t0 = time.perf_counter()
+    result = fn(*args, **kwargs)
+    jax.block_until_ready(result)
+    return result, time.perf_counter() - t0
+
+
+@contextlib.contextmanager
+def device_trace(logdir: str) -> Iterator[None]:
+    """Capture a JAX/XLA profile into ``logdir`` (viewable with
+    TensorBoard's profile plugin / xprof). No-op if the profiler is
+    unavailable on this backend."""
+    try:
+        jax.profiler.start_trace(logdir)
+        started = True
+    except Exception:  # pragma: no cover - backend without profiler
+        started = False
+    try:
+        yield
+    finally:
+        if started:
+            jax.profiler.stop_trace()
+
+
+@dataclass
+class ThroughputMeter:
+    """Accumulate (count, seconds) batches; report rates.
+
+    ``bench.py`` feeds it timed benchmark repetitions. ``rate`` is total
+    count over total seconds (not a mean of rates, which would overweight
+    small batches).
+    """
+
+    counts: List[float] = field(default_factory=list)
+    seconds: List[float] = field(default_factory=list)
+
+    def add(self, count: float, seconds: float) -> None:
+        self.counts.append(float(count))
+        self.seconds.append(float(seconds))
+
+    @property
+    def total_count(self) -> float:
+        return sum(self.counts)
+
+    @property
+    def total_seconds(self) -> float:
+        return sum(self.seconds)
+
+    @property
+    def rate(self) -> Optional[float]:
+        """Items per second over everything recorded; None if no time."""
+        if self.total_seconds <= 0:
+            return None
+        return self.total_count / self.total_seconds
+
+    def summary(self) -> str:
+        r = self.rate
+        return (f"{self.total_count:.0f} in {self.total_seconds:.2f}s"
+                + (f" = {r:.1f}/s" if r is not None else ""))
