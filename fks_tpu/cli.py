@@ -17,6 +17,7 @@ Usage:
 from __future__ import annotations
 
 import argparse
+import contextlib
 import json
 import sys
 import time
@@ -34,11 +35,14 @@ def _apply_platform_flags(args):
 
 
 def _metrics_writer(args):
+    """Context manager: a MetricsWriter when --metrics was given (opened up
+    front so bad paths fail fast, closed on every exit path), else a null
+    context yielding None."""
     if getattr(args, "metrics", ""):
         from fks_tpu.utils import MetricsWriter
 
         return MetricsWriter(args.metrics)
-    return None
+    return contextlib.nullcontext(None)
 
 
 def _parse_workload(args):
@@ -96,34 +100,32 @@ def cmd_bench(args):
     from fks_tpu.models import zoo
     from fks_tpu.sim.engine import SimConfig, simulate
 
+    from fks_tpu.utils import result_record
+
     _, wl = _parse_workload(args)
-    metrics = _metrics_writer(args)
     names = (args.policies.split(",") if args.policies else list(zoo.ZOO))
     dtype = jnp.float64 if args.f64 else jnp.float32
     cfg = SimConfig(score_dtype=dtype, validate_invariants=args.validate)
     print(f"workload: {wl.num_nodes} nodes x {wl.num_pods} pods "
           f"({args.nodes} x {args.trace})", file=sys.stderr)
     rows = []
-    for name in names:
-        if name not in zoo.ZOO:
-            print(f"unknown policy {name!r}; have {list(zoo.ZOO)}",
-                  file=sys.stderr)
-            return 2
-        t0 = time.time()
-        res = simulate(wl, zoo.ZOO[name](dtype=dtype), cfg)
-        res.policy_score.block_until_ready()
-        wall = time.time() - t0
-        rows.append(_result_row(name, res, wall))
-        if metrics:
-            from fks_tpu.utils import result_record
-
-            metrics.write("bench", result_record(res), policy=name,
-                          wall_s=wall, trace=args.trace, nodes=args.nodes)
-        if args.validate and int(res.invariant_violations):
-            print(f"WARNING: {name}: {int(res.invariant_violations)} "
-                  "invariant violations", file=sys.stderr)
-    if metrics:
-        metrics.close()
+    with _metrics_writer(args) as metrics:
+        for name in names:
+            if name not in zoo.ZOO:
+                print(f"unknown policy {name!r}; have {list(zoo.ZOO)}",
+                      file=sys.stderr)
+                return 2
+            t0 = time.time()
+            res = simulate(wl, zoo.ZOO[name](dtype=dtype), cfg)
+            res.policy_score.block_until_ready()
+            wall = time.time() - t0
+            rows.append(_result_row(name, res, wall))
+            if metrics:
+                metrics.write("bench", result_record(res), policy=name,
+                              wall_s=wall, trace=args.trace, nodes=args.nodes)
+            if args.validate and int(res.invariant_violations):
+                print(f"WARNING: {name}: {int(res.invariant_violations)} "
+                      "invariant violations", file=sys.stderr)
     _print_table(rows)
     return 0
 
@@ -138,29 +140,28 @@ def cmd_simulate(args):
     from fks_tpu.models import zoo
     from fks_tpu.sim.engine import SimConfig, simulate
 
+    from fks_tpu.utils import result_record
+
     _, wl = _parse_workload(args)
-    metrics = _metrics_writer(args)  # up front: bad paths fail fast
     dtype = jnp.float64 if args.f64 else jnp.float32
     cfg = SimConfig(score_dtype=dtype, validate_invariants=args.validate)
-    t0 = time.time()
-    res = simulate(wl, zoo.ZOO[args.policy](dtype=dtype), cfg)
-    res.policy_score.block_until_ready()
-    wall = time.time() - t0
-    n_pods = wl.num_pods
-    gpu_pods = int(np.sum(np.asarray(wl.pods.num_gpu)[:n_pods] > 0))
-    out = _result_row(args.policy, res, wall)
-    out.update({
-        "gpu_pods": gpu_pods, "cpu_only_pods": n_pods - gpu_pods,
-        "success_rate": round(100 * int(res.scheduled_pods) / max(1, n_pods), 2),
-        "failed": bool(res.failed), "truncated": bool(res.truncated),
-        "invariant_violations": int(res.invariant_violations),
-    })
-    if metrics:
-        from fks_tpu.utils import result_record
-
-        metrics.write("simulate", result_record(res), policy=args.policy,
-                      wall_s=wall, trace=args.trace, nodes=args.nodes)
-        metrics.close()
+    with _metrics_writer(args) as metrics:  # up front: bad paths fail fast
+        t0 = time.time()
+        res = simulate(wl, zoo.ZOO[args.policy](dtype=dtype), cfg)
+        res.policy_score.block_until_ready()
+        wall = time.time() - t0
+        n_pods = wl.num_pods
+        gpu_pods = int(np.sum(np.asarray(wl.pods.num_gpu)[:n_pods] > 0))
+        out = _result_row(args.policy, res, wall)
+        out.update({
+            "gpu_pods": gpu_pods, "cpu_only_pods": n_pods - gpu_pods,
+            "success_rate": round(100 * int(res.scheduled_pods) / max(1, n_pods), 2),
+            "failed": bool(res.failed), "truncated": bool(res.truncated),
+            "invariant_violations": int(res.invariant_violations),
+        })
+        if metrics:
+            metrics.write("simulate", result_record(res), policy=args.policy,
+                          wall_s=wall, trace=args.trace, nodes=args.nodes)
     print(json.dumps(out, indent=2))
     return 0
 
@@ -183,19 +184,17 @@ def cmd_evolve(args):
         return 2
     _apply_platform_flags(args)
     _, wl = _parse_workload(args)
-    metrics = _metrics_writer(args)
-    on_gen = None
-    if metrics:
-        import dataclasses
+    with _metrics_writer(args) as metrics:
+        on_gen = None
+        if metrics:
+            import dataclasses
 
-        def on_gen(st):
-            # streamed per generation: an interrupted evolution still
-            # leaves a complete metric trail up to the crash point
-            metrics.write("generation", dataclasses.asdict(st))
-    fs = evo.run(wl, cfg, backend=backend, sim_config=SimConfig(),
-                 checkpoint_path=args.checkpoint, on_generation=on_gen)
-    if metrics:
-        metrics.close()
+            def on_gen(st):
+                # streamed per generation: an interrupted evolution still
+                # leaves a complete metric trail up to the crash point
+                metrics.write("generation", dataclasses.asdict(st))
+        fs = evo.run(wl, cfg, backend=backend, sim_config=SimConfig(),
+                     checkpoint_path=args.checkpoint, on_generation=on_gen)
     if fs.best:
         print(f"best fitness: {fs.best[1]:.4f}")
         if args.out:

@@ -192,7 +192,12 @@ class FunSearch:
             self.generator, n_new, self._sample_parents, feedback,
             cfg.max_workers)
 
-        records, eval_s = profiling.block_timed(self.evaluator.evaluate, codes)
+        # plain wall time: evaluate() returns host floats (each candidate's
+        # score is materialized inside), so there is nothing left to sync —
+        # and its EvalRecord dataclasses are opaque to block_until_ready
+        with profiling.timed("evaluate") as t:
+            records = self.evaluator.evaluate(codes)
+        eval_s = t.seconds
 
         accepted = rejected = 0
         for r in records:

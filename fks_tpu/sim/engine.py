@@ -57,6 +57,10 @@ class SimConfig:
     gpu_allocator: str = "best_fit"  # or "first_fit" (main.py:133-134)
     score_dtype: Any = jnp.float32  # evaluator accumulation dtype
     validate_invariants: bool = False  # reference main.py:201-272 (opt-in)
+    # wait-histogram width override (buckets = gpu_milli values of waiting
+    # GPU pods; must exceed the trace's max gpu_milli). Set it when batching
+    # traces whose derived sizes differ so the stacked states share a shape.
+    wait_hist_size: Optional[int] = None
 
     def resolve_max_steps(self, num_pods: int) -> int:
         if self.max_steps is not None:
@@ -76,7 +80,12 @@ def initial_state(workload: Workload, cfg: SimConfig) -> SimState:
         capacity=p.p_padded,
     )
     n, g, pp = c.n_padded, c.g_padded, p.p_padded
-    hist_size = int(max(1001, int(np.asarray(p.gpu_milli).max(initial=0)) + 2))
+    hist_size = cfg.wait_hist_size or int(
+        max(1001, int(np.asarray(p.gpu_milli).max(initial=0)) + 2))
+    if hist_size <= int(np.asarray(p.gpu_milli).max(initial=0)):
+        raise ValueError(
+            f"wait_hist_size {hist_size} <= trace max gpu_milli; "
+            "fragmentation min_needed would be miscounted")
     f = cfg.score_dtype
     return SimState(
         heap=heap,

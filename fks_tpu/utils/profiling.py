@@ -26,30 +26,45 @@ class Timing:
 
     label: str = ""
     seconds: float = 0.0
+    _sync: Any = None
+
+    def sync(self, value):
+        """Register a value (any pytree of jax arrays) produced inside the
+        block; the clock stops only after it is materialized on device.
+        Returns the value for inline use."""
+        self._sync = value
+        return value
 
 
 @contextlib.contextmanager
 def timed(label: str = "", sync: Any = None) -> Iterator[Timing]:
-    """Measure a block's wall time; if ``sync`` is given (any pytree of
-    jax arrays) block until those values are actually materialized on
-    device before stopping the clock.
+    """Measure a block's wall time. For device work, register the block's
+    output via ``t.sync(...)`` so the clock includes the actual compute
+    (JAX dispatch is async; without a sync the delta measures enqueue
+    time). ``sync=`` covers values that already exist at entry.
 
-    >>> with timed("eval", sync=result) as t: ...
+    >>> with timed("eval") as t:
+    ...     result = t.sync(ev(params))
     >>> t.seconds
     """
-    out = Timing(label=label)
+    out = Timing(label=label, _sync=sync)
     t0 = time.perf_counter()
     try:
         yield out
     finally:
-        if sync is not None:
-            jax.block_until_ready(sync)
+        if out._sync is not None:
+            jax.block_until_ready(out._sync)
         out.seconds = time.perf_counter() - t0
 
 
 def block_timed(fn, *args, **kwargs):
     """Call ``fn`` and return (result, seconds) with the result fully
-    materialized — the one-liner version of ``timed``."""
+    materialized — the one-liner version of ``timed``.
+
+    The result must be a pytree of jax arrays (or plain scalars):
+    ``jax.block_until_ready`` treats unregistered custom objects as opaque
+    leaves and silently skips them, so wrapping a function that hides its
+    arrays inside plain dataclasses would time only the enqueue."""
     t0 = time.perf_counter()
     result = fn(*args, **kwargs)
     jax.block_until_ready(result)

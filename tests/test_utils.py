@@ -14,20 +14,40 @@ from fks_tpu.utils import (
 )
 
 
-def test_timed_blocks_on_sync():
-    x = jnp.arange(1024.0)
-    with timed("matmul", sync=None) as t:
-        y = x * 2
+def test_timed_syncs_registered_value(monkeypatch):
+    """The clock must stop only after the value registered via t.sync() is
+    materialized — i.e. block_until_ready is invoked on exactly that value
+    at context exit (deleting the sync would regress to enqueue timing)."""
+    from fks_tpu.utils import profiling
+
+    synced = []
+    monkeypatch.setattr(profiling.jax, "block_until_ready",
+                        lambda v: synced.append(v))
+    sentinel = object()
+    with timed("eval") as t:
+        got = t.sync(sentinel)
+        assert synced == []  # not yet: only at context exit
+    assert got is sentinel
+    assert synced == [sentinel]
     assert t.seconds >= 0
-    with timed("matmul", sync=y) as t2:
+
+    pre = object()
+    with timed("pre-existing", sync=pre):
         pass
-    assert t2.seconds >= 0
+    assert synced == [sentinel, pre]
 
 
-def test_block_timed_returns_result():
+def test_block_timed_returns_materialized_result(monkeypatch):
+    from fks_tpu.utils import profiling
+
+    synced = []
+    real = jax.block_until_ready
+    monkeypatch.setattr(profiling.jax, "block_until_ready",
+                        lambda v: (synced.append(v), real(v))[1])
     r, secs = block_timed(lambda a: a + 1, jnp.ones(8))
     assert float(r[0]) == 2.0
     assert secs > 0
+    assert len(synced) == 1 and synced[0] is r
 
 
 def test_throughput_meter_rate_is_total_over_total():

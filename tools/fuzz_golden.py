@@ -1,0 +1,161 @@
+#!/usr/bin/env python3
+"""Differential-fuzz fixture generator: random micro workloads through the
+RUNNING reference implementation.
+
+Extends tools/make_golden.py's approach (execute the read-only reference at
+/root/reference, record observables — no code copied) from 4 fixed traces to
+a seeded population of adversarial micro workloads: heavy creation-time
+ties, infeasible pods (retry/drop paths), GPU-sharing contention,
+multi-GPU packing, zero durations, shuffled pod-id tie ranks. The recorded
+behavior — fitness, snapshot/event counts, per-pod placements and GPU
+picks, retry-mutated creation times, final per-resource remnants — is the
+bar for tests/test_differential.py.
+
+Reference entry points exercised (cited for parity checking):
+  - simulator/entities.py GPU/Node/Cluster/Pod constructors
+  - simulator/event_simulator.py DiscreteEventSimulator
+  - simulator/main.py KubernetesSimulator.run_schedule
+  - simulator/evaluator.py SchedulingEvaluator
+  - tests/test_scheduler.py first_fit/best_fit schedulers
+
+Regenerate with:  python tools/fuzz_golden.py
+"""
+import json
+import os
+import random
+import sys
+
+REF = "/root/reference"
+sys.path.insert(0, REF)
+sys.path.insert(0, os.path.join(REF, "tests"))
+sys.dont_write_bytecode = True
+
+from simulator.entities import GPU, Node, Cluster, Pod  # noqa: E402
+from simulator.event_simulator import DiscreteEventSimulator  # noqa: E402
+from simulator.main import KubernetesSimulator  # noqa: E402
+from simulator.evaluator import SchedulingEvaluator  # noqa: E402
+import test_scheduler as zoo  # noqa: E402
+
+OUT = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..",
+                   "tests", "fixtures", "golden_fuzz.json")
+
+N_CASES = 48
+GPU_MEM_CHOICES = [7611, 15109, 22919, 32510]
+
+
+def gen_case(rng: random.Random):
+    """One random workload spec (plain dicts, JSON-able)."""
+    n_nodes = rng.randint(1, 6)
+    nodes = []
+    for i in range(n_nodes):
+        n_gpu = rng.choice([0, 0, 1, 2, 4])
+        nodes.append({
+            "node_id": f"node-{i:02d}",
+            "cpu_milli": rng.randrange(500, 8001, 100),
+            "memory_mib": rng.randrange(512, 16385, 128),
+            "gpus": [1000] * n_gpu,
+            "gpu_memory_mib": rng.choice(GPU_MEM_CHOICES),
+        })
+    n_pods = rng.randint(3, 40)
+    ids = list(range(n_pods))
+    rng.shuffle(ids)  # pod-id lexicographic rank != arrival order
+    pods = []
+    for k in range(n_pods):
+        has_gpu = rng.random() < 0.6
+        num_gpu = rng.choice([1, 1, 1, 2, 3]) if has_gpu else 0
+        pods.append({
+            "pod_id": f"pod-{ids[k]:03d}",
+            "cpu_milli": rng.randrange(0, 5001, 50),
+            "memory_mib": rng.randrange(0, 8193, 64),
+            "num_gpu": num_gpu,
+            "gpu_milli": rng.choice([50, 100, 250, 500, 1000]) if has_gpu else 0,
+            "creation_time": rng.randint(0, 30),  # heavy ties
+            "duration_time": rng.choice([0, 1, 2, 5, 10, 40]),
+        })
+    return {"nodes": nodes, "pods": pods}
+
+
+def ref_build(case):
+    nodes_dict = {}
+    for spec in case["nodes"]:
+        gpus = [GPU(memory_mib_left=spec["gpu_memory_mib"],
+                    memory_mib_total=spec["gpu_memory_mib"],
+                    gpu_milli_left=m, gpu_milli_total=m)
+                for m in spec["gpus"]]
+        nodes_dict[spec["node_id"]] = Node(
+            node_id=spec["node_id"],
+            cpu_milli_left=spec["cpu_milli"], cpu_milli_total=spec["cpu_milli"],
+            memory_mib_left=spec["memory_mib"], memory_mib_total=spec["memory_mib"],
+            gpu_left=len(gpus), gpus=gpus)
+    pods = [Pod(pod_id=s["pod_id"], cpu_milli=s["cpu_milli"],
+                memory_mib=s["memory_mib"], num_gpu=s["num_gpu"],
+                gpu_milli=s["gpu_milli"], gpu_spec="",
+                creation_time=s["creation_time"],
+                duration_time=s["duration_time"],
+                assigned_node="", assigned_gpus=[])
+            for s in case["pods"]]
+    return Cluster(nodes_dict=nodes_dict), pods
+
+
+def ref_run(case, policy):
+    cluster, pods = ref_build(case)
+    node_index = {nid: i for i, nid in enumerate(cluster.nodes_dict)}
+    ev = DiscreteEventSimulator(pods)
+    evaluator = SchedulingEvaluator(cluster, enabled=True)
+    sim = KubernetesSimulator(cluster, pods, ev, policy, evaluator=evaluator)
+    try:
+        sim.run_schedule()
+    except ValueError as e:
+        # GPU sub-allocation shortfall aborts the run (main.py:164-165);
+        # the caller maps it to fitness 0 (funsearch_integration.py:63-64)
+        return {"aborted": True, "error": str(e)[:80]}
+    res = evaluator.get_evaluation_results()
+    return {
+        "aborted": False,
+        "policy_score": evaluator.get_policy_score(pods),
+        "num_snapshots": res.num_snapshots,
+        "num_fragmentation_events": res.num_fragmentation_events,
+        "gpu_fragmentation_score": res.gpu_fragmentation_score,
+        "avg_cpu_utilization": res.avg_cpu_utilization,
+        "avg_memory_utilization": res.avg_memory_utilization,
+        "avg_gpu_count_utilization": res.avg_gpu_count_utilization,
+        "avg_gpu_memory_utilization": res.avg_gpu_memory_utilization,
+        "events_processed": evaluator.events_processed,
+        "max_nodes": sim.max_nodes,
+        "scheduled_pods": sum(1 for p in pods if p.assigned_node != ""),
+        "assignments": [node_index.get(p.assigned_node, -1) for p in pods],
+        "assigned_gpus": [sorted(p.assigned_gpus) for p in pods],
+        "final_creation_time": [p.creation_time for p in pods],
+        "final_cpu_left": [n.cpu_milli_left for n in cluster.nodes_dict.values()],
+        "final_mem_left": [n.memory_mib_left for n in cluster.nodes_dict.values()],
+        "final_gpu_left": [n.gpu_left for n in cluster.nodes_dict.values()],
+        "final_gpu_milli_left": [[g.gpu_milli_left for g in n.gpus]
+                                 for n in cluster.nodes_dict.values()],
+    }
+
+
+def main():
+    rng = random.Random(20260729)
+    policies = {"first_fit": zoo.first_fit_scheduler,
+                "best_fit": zoo.best_fit_scheduler,
+                "funsearch_4901": zoo.funsearch_4901_scheduler}
+    cases = []
+    aborted = 0
+    for i in range(N_CASES):
+        case = gen_case(rng)
+        results = {}
+        for name, fn in policies.items():
+            results[name] = ref_run(case, fn)
+            aborted += results[name]["aborted"]
+        cases.append({"id": i, **case, "results": results})
+        scores = {n: round(r.get("policy_score", -1), 4)
+                  for n, r in results.items()}
+        print(f"case {i:02d}: nodes={len(case['nodes'])} "
+              f"pods={len(case['pods'])} scores={scores}", flush=True)
+    with open(OUT, "w") as f:
+        json.dump({"seed": 20260729, "cases": cases}, f)
+    print(f"wrote {len(cases)} cases ({aborted} aborted runs) to {OUT}")
+
+
+if __name__ == "__main__":
+    main()
