@@ -8,6 +8,7 @@ from fks_tpu.parallel.population import (  # noqa: F401
     ParamPolicyFn, fitness, make_population_eval, make_single_run,
 )
 from fks_tpu.parallel.mesh import (  # noqa: F401
-    POP_AXIS, make_sharded_eval, make_sharded_generation_step,
-    pad_population, population_mesh,
+    DCN_AXIS, POP_AXIS, hybrid_population_mesh, init_distributed,
+    make_sharded_eval, make_sharded_generation_step, pad_population,
+    population_mesh,
 )

@@ -14,9 +14,17 @@ selection is a host-side Python sort at :494-496). Here:
 - only elite indices/scores return to host — candidate weights can stay
   device-resident across generations.
 
-Single-host multi-chip uses one mesh over ``jax.devices()``; multi-host
-(DCN) uses the same code with ``jax.distributed.initialize`` — shard_map
-and the collectives are topology-agnostic by design.
+Single-host multi-chip uses one 1-D mesh over ``jax.devices()``.
+Multi-slice / multi-host topologies use ``hybrid_population_mesh``: a 2-D
+``("dcn", "pop")`` mesh whose outer axis crosses slice (DCN) boundaries and
+whose inner axis rides ICI, after ``init_distributed()`` has brought up the
+process group. The population is sharded over BOTH axes (it is the problem's
+only parallel dimension); the fitness all-gather for elite ranking then
+decomposes into an ICI gather within each slice and one DCN hop across
+slices — collectives ride the fast fabric wherever possible, exactly the
+layered layout the scaling playbook prescribes. shard_map and the
+collectives are topology-agnostic; every entry point below accepts either
+mesh shape.
 """
 from __future__ import annotations
 
@@ -34,6 +42,7 @@ from fks_tpu.parallel.population import ParamPolicyFn
 from fks_tpu.sim.engine import SimConfig, initial_state, make_population_run_fn
 
 POP_AXIS = "pop"
+DCN_AXIS = "dcn"
 
 
 def population_mesh(devices: Optional[Sequence] = None) -> Mesh:
@@ -47,12 +56,84 @@ def population_mesh(devices: Optional[Sequence] = None) -> Mesh:
     return Mesh(devices, (POP_AXIS,))
 
 
-def pad_population(params: jax.Array, num_shards: int):
-    """Pad C up to a multiple of the mesh size; returns (padded, real_count).
+def init_distributed(coordinator_address: Optional[str] = None,
+                     num_processes: Optional[int] = None,
+                     process_id: Optional[int] = None) -> int:
+    """Bring up the JAX process group for multi-host runs (the reference's
+    only inter-worker substrate is a single-host ProcessPoolExecutor,
+    funsearch_integration.py:535-562 — it has no multi-host story at all).
+
+    On TPU pods with standard env (TPU_WORKER_HOSTNAMES etc.) the arguments
+    auto-detect; pass them explicitly elsewhere. No-op when the process
+    group is already up. A failed bring-up RAISES when explicit arguments
+    were given (silently degrading a 2-host launch to one process would run
+    at the wrong scale with no error); with auto-detection only, failure
+    means single-process and is suppressed. Returns the process count.
+    """
+    explicit = any(v is not None
+                   for v in (coordinator_address, num_processes, process_id))
+    if not jax.distributed.is_initialized():
+        try:
+            jax.distributed.initialize(
+                coordinator_address=coordinator_address,
+                num_processes=num_processes, process_id=process_id)
+        except (RuntimeError, ValueError):
+            if explicit:
+                raise
+            # auto-detect found no cluster env: single-process run
+    return jax.process_count()
+
+
+def hybrid_population_mesh(devices: Optional[Sequence] = None,
+                           num_slices: Optional[int] = None) -> Mesh:
+    """A 2-D ``("dcn", "pop")`` mesh: outer axis across slices/hosts (DCN),
+    inner axis within a slice (ICI). The population shards over both; the
+    elite all-gather then moves one message per slice over DCN instead of
+    per-device traffic.
+
+    ``num_slices`` defaults to ``jax.process_count()`` (multi-host) and must
+    divide the device count. With one slice this degenerates to a
+    ``[1, n]`` mesh — same program, no DCN axis traffic.
+    """
+    devices = np.asarray(devices if devices is not None else jax.devices())
+    n = devices.size
+    slices = num_slices or max(1, jax.process_count())
+    if n % slices:
+        raise ValueError(f"{n} devices not divisible into {slices} slices")
+    return Mesh(devices.reshape(slices, n // slices), (DCN_AXIS, POP_AXIS))
+
+
+def _pop_axes(mesh: Mesh):
+    """The axes the population is sharded over, in mesh order: ("pop",) on
+    a 1-D mesh, ("dcn", "pop") on a hybrid mesh."""
+    return tuple(a for a in mesh.axis_names if a in (DCN_AXIS, POP_AXIS))
+
+
+def _num_shards(mesh: Mesh) -> int:
+    n = 1
+    for a in _pop_axes(mesh):
+        n *= mesh.shape[a]
+    return n
+
+
+def _shard_index(mesh: Mesh):
+    """Linearized shard id inside shard_map (row-major over the pop axes)."""
+    axes = _pop_axes(mesh)
+    idx = jax.lax.axis_index(axes[0])
+    for a in axes[1:]:
+        idx = idx * mesh.shape[a] + jax.lax.axis_index(a)
+    return idx
+
+
+def pad_population(params: jax.Array, num_shards):
+    """Pad C up to a multiple of the shard count (pass the mesh itself or an
+    int); returns (padded, real_count).
 
     Pass ``real_count`` back into the sharded eval so pad slots (duplicates
     of the last candidate) are masked out of elite selection.
     """
+    if isinstance(num_shards, Mesh):
+        num_shards = _num_shards(num_shards)
     c = params.shape[0]
     target = -(-c // num_shards) * num_shards
     if target != c:
@@ -62,18 +143,20 @@ def pad_population(params: jax.Array, num_shards: int):
 
 
 def _shard_params(params: jax.Array, mesh: Mesh) -> jax.Array:
-    if params.shape[0] % mesh.shape[POP_AXIS]:
+    if params.shape[0] % _num_shards(mesh):
         raise ValueError(
-            f"population {params.shape[0]} not divisible by mesh size "
-            f"{mesh.shape[POP_AXIS]}; use pad_population()")
-    return jax.device_put(params, NamedSharding(mesh, P(POP_AXIS)))
+            f"population {params.shape[0]} not divisible by shard count "
+            f"{_num_shards(mesh)}; use pad_population()")
+    return jax.device_put(params, NamedSharding(mesh, P(_pop_axes(mesh))))
 
 
-def _global_scores(run, state0, params_shard):
-    """Per-shard batched fitness + the ICI all-gather of the full population
-    fitness vector (shared preamble of eval and generation-step)."""
+def _global_scores(run, state0, params_shard, axes):
+    """Per-shard batched fitness + the all-gather of the full population
+    fitness vector (shared preamble of eval and generation-step). On a 1-D
+    mesh the gather rides ICI only; on a hybrid mesh XLA decomposes the
+    multi-axis gather into ICI-within-slice + one DCN hop."""
     local_scores = run(params_shard, state0).policy_score
-    return local_scores, jax.lax.all_gather(local_scores, POP_AXIS, tiled=True)
+    return local_scores, jax.lax.all_gather(local_scores, axes, tiled=True)
 
 
 def _mask_pad(scores, real_count):
@@ -117,15 +200,17 @@ def make_sharded_eval(workload: Workload, mesh: Mesh,
     """
     run = make_population_run_fn(workload, param_policy, cfg)
     state0 = initial_state(workload, cfg)
+    axes = _pop_axes(mesh)
 
     @functools.partial(
         jax.shard_map, mesh=mesh,
-        in_specs=(P(POP_AXIS), P()),
-        out_specs=(P(POP_AXIS), P(), P()),
+        in_specs=(P(axes), P()),
+        out_specs=(P(axes), P(), P()),
         check_vma=False,
     )
     def shard_eval(params_shard, real_count):
-        local_scores, global_scores = _global_scores(run, state0, params_shard)
+        local_scores, global_scores = _global_scores(
+            run, state0, params_shard, axes)
         elite_scores, elite_idx = _top_k_real(global_scores, real_count, elite_k)
         return local_scores, elite_idx, elite_scores
 
@@ -157,23 +242,25 @@ def make_sharded_generation_step(workload: Workload, mesh: Mesh,
     """
     run = make_population_run_fn(workload, param_policy, cfg)
     state0 = initial_state(workload, cfg)
+    axes = _pop_axes(mesh)
 
     @functools.partial(
         jax.shard_map, mesh=mesh,
-        in_specs=(P(POP_AXIS), P(), P()),
-        out_specs=(P(POP_AXIS), P(POP_AXIS), P()),
+        in_specs=(P(axes), P(), P()),
+        out_specs=(P(axes), P(axes), P()),
         check_vma=False,
     )
     def gen_step(params_shard, key, real_count):
-        local_scores, global_scores = _global_scores(run, state0, params_shard)
-        all_params = jax.lax.all_gather(params_shard, POP_AXIS, tiled=True)
+        local_scores, global_scores = _global_scores(
+            run, state0, params_shard, axes)
+        all_params = jax.lax.all_gather(params_shard, axes, tiled=True)
         elite_scores, elite_idx = _top_k_real(global_scores, real_count, elite_k)
         elites = all_params[elite_idx]
 
         # Per-shard offspring: elites survive in shard 0's slots, the rest
         # mutate from a random elite. Keys are folded per-shard so shards
         # draw independent noise.
-        shard_id = jax.lax.axis_index(POP_AXIS)
+        shard_id = _shard_index(mesh)
         k = jax.random.fold_in(key, shard_id)
         local_c = params_shard.shape[0]
         offspring = parametric.mutate(k, elites, local_c, noise)

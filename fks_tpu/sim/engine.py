@@ -80,9 +80,10 @@ def initial_state(workload: Workload, cfg: SimConfig) -> SimState:
         capacity=p.p_padded,
     )
     n, g, pp = c.n_padded, c.g_padded, p.p_padded
-    hist_size = cfg.wait_hist_size or int(
-        max(1001, int(np.asarray(p.gpu_milli).max(initial=0)) + 2))
-    if hist_size <= int(np.asarray(p.gpu_milli).max(initial=0)):
+    max_milli = int(np.asarray(p.gpu_milli).max(initial=0))
+    hist_size = (cfg.wait_hist_size if cfg.wait_hist_size is not None
+                 else max(1001, max_milli + 2))
+    if hist_size <= max_milli:
         raise ValueError(
             f"wait_hist_size {hist_size} <= trace max gpu_milli; "
             "fragmentation min_needed would be miscounted")

@@ -114,3 +114,44 @@ def test_generation_step_preserves_elites(wl, pop8):
     np.testing.assert_allclose(
         np.asarray(res2.policy_score),
         np.sort(np.asarray(elite_scores))[::-1])
+
+
+# ---------------------------------------------------------------- hybrid mesh
+
+def test_hybrid_mesh_matches_flat_mesh(wl, pop8):
+    """2-D ("dcn","pop") mesh (multi-slice topology modeled on the 8 virtual
+    devices as 2 slices x 4 chips) must produce identical fitness and elite
+    selection to the 1-D mesh and to plain vmap."""
+    from fks_tpu.parallel import DCN_AXIS, hybrid_population_mesh
+
+    mesh = hybrid_population_mesh(num_slices=2)
+    assert mesh.shape[DCN_AXIS] == 2 and mesh.shape[POP_AXIS] == 4
+    padded, real = pad_population(pop8, mesh)
+    assert real == 8
+    scores, elite_idx, elite_scores = make_sharded_eval(
+        wl, mesh, elite_k=4)(padded)
+    ref = make_population_eval(wl)(pop8).policy_score
+    np.testing.assert_array_equal(np.asarray(scores), np.asarray(ref))
+
+    flat = make_sharded_eval(wl, population_mesh(), elite_k=4)(pop8)
+    np.testing.assert_array_equal(np.asarray(elite_idx), np.asarray(flat[1]))
+    np.testing.assert_array_equal(np.asarray(elite_scores), np.asarray(flat[2]))
+
+
+def test_hybrid_generation_step_runs_and_preserves_elites(wl, pop8):
+    from fks_tpu.parallel import hybrid_population_mesh
+
+    mesh = hybrid_population_mesh(num_slices=2)
+    step = make_sharded_generation_step(wl, mesh, elite_k=4, noise=0.05)
+    new_params, scores, elite_scores = step(pop8, jax.random.PRNGKey(1))
+    assert new_params.shape == pop8.shape
+    top = np.asarray(jax.lax.top_k(scores, 4)[1])
+    np.testing.assert_allclose(
+        np.asarray(new_params)[:4], np.asarray(pop8)[top], rtol=0, atol=0)
+
+
+def test_hybrid_mesh_rejects_indivisible_slices():
+    from fks_tpu.parallel import hybrid_population_mesh
+
+    with pytest.raises(ValueError, match="divisible"):
+        hybrid_population_mesh(num_slices=3)
