@@ -12,6 +12,7 @@ Usage:
     python -m fks_tpu.cli bench [--policies a,b,...] [--trace F] [--nodes F]
     python -m fks_tpu.cli simulate --policy best_fit [--validate]
     python -m fks_tpu.cli evolve [--config F] [--fake-llm] [--checkpoint F]
+    python -m fks_tpu.cli scale [--nodes-count N] [--pods-count P] [--pop C]
     python -m fks_tpu.cli traces
 """
 from __future__ import annotations
@@ -203,6 +204,61 @@ def cmd_evolve(args):
     return 0
 
 
+def cmd_scale(args):
+    """Synthetic scale run (BASELINE.json config 5 shape): N-node x P-pod
+    generated trace, population-parallel evaluation, throughput report.
+    Uses the device mesh when more than one device is visible, plain vmap
+    otherwise."""
+    _apply_platform_flags(args)
+    import jax
+
+    from fks_tpu.data.synthetic import synthetic_workload
+    from fks_tpu.models import parametric
+    from fks_tpu.parallel import (
+        make_population_eval, make_sharded_eval, pad_population,
+        population_mesh,
+    )
+    from fks_tpu.sim.engine import SimConfig
+    from fks_tpu.utils import ThroughputMeter, timed
+
+    with _metrics_writer(args) as metrics:  # up front: bad paths fail fast
+        wl = synthetic_workload(args.nodes_count, args.pods_count,
+                                seed=args.seed)
+        print(f"synthetic workload: {wl.num_nodes} nodes x {wl.num_pods} "
+              f"pods, population {args.pop}", file=sys.stderr)
+        pop = parametric.init_population(
+            jax.random.PRNGKey(args.seed), args.pop, noise=0.1)
+        cfg = SimConfig()
+        devices = jax.devices()
+        if len(devices) > 1:
+            mesh = population_mesh(devices)
+            padded, real = pad_population(pop, mesh)
+            ev = make_sharded_eval(wl, mesh, cfg=cfg,
+                                   elite_k=min(4, args.pop))
+            with timed("eval") as t:
+                scores = t.sync(ev(padded, real)[0])[:real]
+            mode = f"sharded over {len(devices)} devices"
+        else:
+            evp = make_population_eval(wl, cfg=cfg)
+            with timed("eval") as t:
+                res = t.sync(evp(pop))
+            scores = res.policy_score
+            mode = "vmap on 1 device"
+        meter = ThroughputMeter()
+        meter.add(args.pop, t.seconds)
+        out = {
+            "mode": mode, "nodes": wl.num_nodes, "pods": wl.num_pods,
+            "population": args.pop, "wall_s": round(t.seconds, 3),
+            "evals_per_sec": round(meter.rate, 3),
+            "score_min": round(float(scores.min()), 4),
+            "score_max": round(float(scores.max()), 4),
+        }
+        if metrics:
+            metrics.write("scale", out)
+    print(json.dumps(out, indent=2))
+    return 0
+
+
 def cmd_traces(args):
     """Dataset discovery (reference: parser.py:103-115)."""
     from fks_tpu.data import TraceParser
@@ -252,6 +308,14 @@ def main(argv=None) -> int:
     e.add_argument("--out", default="", help="directory for champion JSONs")
     e.add_argument("--generations", type=int, default=None)
     e.set_defaults(fn=cmd_evolve)
+
+    sc = sub.add_parser("scale", help="synthetic scale run + throughput",
+                        parents=[common])
+    sc.add_argument("--nodes-count", type=int, default=1000)
+    sc.add_argument("--pods-count", type=int, default=100000)
+    sc.add_argument("--pop", type=int, default=8)
+    sc.add_argument("--seed", type=int, default=0)
+    sc.set_defaults(fn=cmd_scale)
 
     t = sub.add_parser("traces", help="list available trace files")
     t.set_defaults(fn=cmd_traces)

@@ -43,11 +43,17 @@ _GPU_COUNTS = ((1, 0.9894), (2, 0.0023), (4, 0.0021), (8, 0.0062))
 def synthetic_workload(num_nodes: int, num_pods: int, seed: int = 0,
                        horizon: int = 12_900_000,
                        gpu_pod_frac: float = 0.8665,
+                       load: float | None = 0.45,
                        pad_to: Tuple[int, int, int] | None = None) -> Workload:
     """Generate a cluster + pod stream of the requested size.
 
     ``horizon`` is the creation-time span (default: the default trace's
-    ~12.9M-second span, SURVEY.md §2 fine print 11). ``pad_to`` optionally
+    ~12.9M-second span, SURVEY.md §2 fine print 11). ``load`` calibrates
+    offered load: durations are rescaled so the binding resource's expected
+    concurrent demand is ``load`` x cluster capacity (default 0.45 — the
+    default trace's utilization regime, where everything eventually
+    schedules; pass None to skip calibration and allow oversubscription,
+    which exercises the retry/drop paths instead). ``pad_to`` optionally
     forces (N, G, P) padded shapes (used by bucketing).
     """
     rng = np.random.default_rng(seed)
@@ -75,6 +81,28 @@ def synthetic_workload(num_nodes: int, num_pods: int, seed: int = 0,
     duration = rng.integers(60, max(61, horizon // 4), num_pods)
     cpu = rng.integers(100, 16000, num_pods)
     mem = rng.integers(128, 65536, num_pods)
+
+    if load is not None:
+        # offered load per resource = sum(demand_i * dur_i) / (horizon * cap);
+        # rescale durations so the binding resource sits at `load`
+        cap = {
+            "cpu": sum(n["cpu_milli"] for n in nodes),
+            "mem": sum(n["memory_mib"] for n in nodes),
+            "gpus": sum(len(n["gpus"]) for n in nodes),
+            "milli": sum(sum(n["gpus"]) for n in nodes),
+        }
+        demand = {
+            "cpu": cpu.astype(np.int64), "mem": mem.astype(np.int64),
+            "gpus": num_gpu.astype(np.int64),
+            "milli": (num_gpu * gpu_milli).astype(np.int64),
+        }
+        worst = max(
+            float(np.sum(demand[k] * duration.astype(np.int64)))
+            / (horizon * cap[k])
+            for k in cap if cap[k] > 0)
+        if worst > 0:
+            duration = np.maximum(
+                60, (duration * (load / worst)).astype(np.int64))
 
     pods = [{
         "pod_id": f"spod-{i:06d}", "cpu_milli": int(cpu[i]),

@@ -24,3 +24,18 @@ def test_evolve_requires_key_or_fake(capsys):
 def test_requires_subcommand():
     with pytest.raises(SystemExit):
         cli.main([])
+
+
+def test_cli_scale_synthetic(capsys):
+    from fks_tpu.cli import main
+
+    rc = main(["scale", "--nodes-count", "16", "--pods-count", "300",
+               "--pop", "2", "--seed", "1"])
+    assert rc == 0
+    import json as _json
+
+    out = _json.loads(capsys.readouterr().out)
+    assert out["pods"] == 300 and out["population"] == 2
+    assert out["evals_per_sec"] > 0
+    # calibrated load: the seed population should actually schedule
+    assert out["score_max"] > 0
