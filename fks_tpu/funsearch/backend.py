@@ -15,23 +15,35 @@ validation, transpile, or execution — maps to fitness 0.0 and the candidate
 stays in the pool's view (reference: funsearch_integration.py:63-64;
 SURVEY.md §2 fine print 8).
 
-Two throughput tiers:
-- code candidates: one compiled program per unique AST (this module);
+Three throughput tiers:
+- VM candidates (default): the candidate's jaxpr is lowered to a register
+  program (fks_tpu.funsearch.vm) and interpreted by ONE engine program
+  compiled once per evaluator — a fresh candidate costs a device run, not
+  an XLA compile;
+- jit candidates (fallback): one compiled program per unique AST, for the
+  rare candidate outside the VM vocabulary;
 - parametric candidates: one program TOTAL for the whole population
   (fks_tpu.parallel.population / .mesh) — the fast path the evolution
   controller uses for weight-vector mutation between LLM rounds.
 """
 from __future__ import annotations
 
+import concurrent.futures
 import dataclasses
+import os
+import threading
 from typing import Dict, List, Optional, Sequence
 
 import jax
 import numpy as np
 
+import dataclasses as _dc
+
 from fks_tpu.data.entities import Workload
-from fks_tpu.funsearch import transpiler
-from fks_tpu.sim.engine import SimConfig, initial_state, make_run_fn
+from fks_tpu.funsearch import transpiler, vm
+from fks_tpu.sim.engine import (
+    SimConfig, initial_state, make_param_run_fn, make_run_fn,
+)
 from fks_tpu.sim.types import SimResult
 
 
@@ -58,29 +70,74 @@ class CodeEvaluator:
     traced computation.
     """
 
-    def __init__(self, workload: Workload, cfg: SimConfig = SimConfig()):
+    VM_CAPACITY = 512  # op budget; longer programs use the jit tier
+
+    def __init__(self, workload: Workload, cfg: SimConfig = SimConfig(),
+                 max_workers: Optional[int] = None, use_vm: bool = True):
         self.workload = workload
         self.cfg = cfg
         self.state0 = initial_state(workload, cfg)
         self._cache: Dict[str, object] = {}
+        self._lock = threading.Lock()
         self.compile_count = 0  # observability: unique programs built
+        self.vm_count = 0  # candidates served by the VM tier (no compile)
+        self.max_workers = max_workers or min(8, os.cpu_count() or 1)
+        self.use_vm = use_vm
+        self._vm_run = None  # lazily built shared engine program
+
+    # ----- VM tier: one engine program, candidates as data
+
+    def _vm_runner(self):
+        if self._vm_run is None:
+            # the VM interpreter is expensive per event; skip it on
+            # deletions (cond_policy) — this tier runs unbatched, where
+            # lax.cond executes one branch
+            cfg = _dc.replace(self.cfg, cond_policy=True)
+            self._vm_run = jax.jit(
+                make_param_run_fn(self.workload, vm.score, cfg))
+        return self._vm_run
+
+    def _try_vm(self, code: str) -> Optional[SimResult]:
+        """SimResult via the shared interpreter program, or None when the
+        candidate is outside the VM vocabulary (caller jits it instead)."""
+        c = self.workload.cluster
+        try:
+            prog = vm.compile_policy(code, c.n_padded, c.g_padded,
+                                     capacity=self.VM_CAPACITY)
+        except vm.VMUnsupported:
+            return None
+        with self._lock:
+            self.vm_count += 1
+        return self._vm_runner()(prog, self.state0)
 
     def _compiled(self, code: str):
         key = transpiler.canonical_key(code)
-        fn = self._cache.get(key)
+        with self._lock:
+            fn = self._cache.get(key)
         if fn is None:
+            # transpile + trace OUTSIDE the lock: XLA compilation is native
+            # code (GIL released), so distinct candidates compile in
+            # parallel across evaluate()'s thread pool
             policy = transpiler.transpile(code)
             fn = jax.jit(make_run_fn(self.workload, policy, self.cfg))
-            self._cache[key] = fn
-            self.compile_count += 1
+            with self._lock:
+                if key in self._cache:  # lost the race: reuse the winner
+                    fn = self._cache[key]
+                else:
+                    self._cache[key] = fn
+                    self.compile_count += 1
         return fn
 
     def evaluate_one(self, code: str) -> EvalRecord:
         """Reference semantics: exceptions -> score 0 with the reason kept
         (the reference loses the reason; we keep it for observability)."""
         try:
-            run = self._compiled(code)
-            result: SimResult = run(self.state0)
+            result: Optional[SimResult] = None
+            if self.use_vm:
+                result = self._try_vm(code)
+            if result is None:
+                run = self._compiled(code)
+                result = run(self.state0)
             score = float(result.policy_score)
             if bool(result.failed):
                 return EvalRecord(code, 0.0, "gpu allocation aborted", result)
@@ -93,19 +150,41 @@ class CodeEvaluator:
             return EvalRecord(code, 0.0, f"runtime: {e}")
 
     def evaluate(self, codes: Sequence[str]) -> List[EvalRecord]:
-        """Evaluate a batch; duplicate sources are computed once."""
-        memo: Dict[str, EvalRecord] = {}
-        out = []
-        for code in codes:
+        """Evaluate a batch; duplicate sources are computed once.
+
+        Unique candidates fan out over a thread pool: each candidate is a
+        distinct XLA program whose compile (the dominant cost, several
+        seconds each) runs in native code with the GIL released, so the
+        batch compiles concurrently while device executions interleave.
+        Result order — and therefore population admission order — matches
+        the input order regardless of completion order.
+        """
+        keyed: List[Optional[str]] = []
+        errors: Dict[int, EvalRecord] = {}
+        for i, code in enumerate(codes):
             try:
-                key = transpiler.canonical_key(code)
+                keyed.append(transpiler.canonical_key(code))
             except SyntaxError as e:
-                out.append(EvalRecord(code, 0.0, f"syntax: {e}"))
-                continue
-            if key not in memo:
-                memo[key] = self.evaluate_one(code)
-            r = memo[key]
-            out.append(EvalRecord(code, r.score, r.error, r.result))
+                keyed.append(None)
+                errors[i] = EvalRecord(code, 0.0, f"syntax: {e}")
+        unique: Dict[str, str] = {}
+        for key, code in zip(keyed, codes):
+            if key is not None and key not in unique:
+                unique[key] = code
+        memo: Dict[str, EvalRecord] = {}
+        if unique:
+            with concurrent.futures.ThreadPoolExecutor(
+                    max_workers=self.max_workers) as ex:
+                futs = {key: ex.submit(self.evaluate_one, code)
+                        for key, code in unique.items()}
+                memo = {key: f.result() for key, f in futs.items()}
+        out = []
+        for i, (key, code) in enumerate(zip(keyed, codes)):
+            if key is None:
+                out.append(errors[i])
+            else:
+                r = memo[key]
+                out.append(EvalRecord(code, r.score, r.error, r.result))
         return out
 
     def scores(self, codes: Sequence[str]) -> np.ndarray:

@@ -61,6 +61,11 @@ class SimConfig:
     # GPU pods; must exceed the trace's max gpu_milli). Set it when batching
     # traces whose derived sizes differ so the stacked states share a shape.
     wait_hist_size: Optional[int] = None
+    # skip the policy on non-creation events via lax.cond. A win when the
+    # policy is expensive (the funsearch VM interpreter) AND the loop runs
+    # unbatched — under vmap, cond degenerates to executing both branches,
+    # so batched paths should keep this off.
+    cond_policy: bool = False
 
     def resolve_max_steps(self, num_pods: int) -> int:
         if self.max_steps is not None:
@@ -189,7 +194,14 @@ def build_step(workload: Workload, policy: PolicyFn, cfg: SimConfig,
         # ---- CREATION: score every node, strict argmax (main.py:101-111)
         pod_view = PodView(pcpu, pmem, pngpu, pmilli, s.pod_ctime[pod], pdur)
         node_view = _node_view(c, cpu_left, mem_left, gpu_left, gpu_milli_left)
-        scores = jnp.where(c.node_mask, policy(pod_view, node_view), 0)
+        if cfg.cond_policy:
+            out = jax.eval_shape(policy, pod_view, node_view)
+            raw_scores = jax.lax.cond(
+                create, lambda: jnp.asarray(policy(pod_view, node_view)),
+                lambda: jnp.zeros(out.shape, out.dtype))
+        else:
+            raw_scores = policy(pod_view, node_view)
+        scores = jnp.where(c.node_mask, raw_scores, 0)
         b = jnp.argmax(scores).astype(jnp.int32)
         placed = create & (scores[b] > 0)
 

@@ -1,0 +1,132 @@
+"""The candidate VM: jaxpr->bytecode lowering + on-device interpretation
+(fks_tpu.funsearch.vm). Contract: for every candidate it accepts, the VM's
+scores EQUAL the directly-transpiled policy's scores (integer-exact), and
+full-simulation fitness through the shared engine program equals the
+per-candidate jit tier; candidates outside the vocabulary fall back."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fks_tpu.funsearch import backend, llm, template, transpiler, vm
+from fks_tpu.sim.types import NodeView, PodView
+
+N, G = 16, 8
+
+
+def _rand_views(rng):
+    pod = PodView(*(jnp.int32(x) for x in (
+        rng.integers(0, 5000), rng.integers(0, 8000), rng.integers(0, 4),
+        rng.integers(0, 1001), rng.integers(0, 100), rng.integers(0, 50))))
+    tot = rng.integers(1, 10000, N).astype(np.int32)
+    left = (tot * rng.random(N)).astype(np.int32)
+    mt = rng.integers(1, 20000, N).astype(np.int32)
+    ml = (mt * rng.random(N)).astype(np.int32)
+    ng = rng.integers(0, G + 1, N).astype(np.int32)
+    gmask = np.arange(G)[None, :] < ng[:, None]
+    gmt = np.where(gmask, 1000, 0).astype(np.int32)
+    gml = (gmt * rng.random((N, G))).astype(np.int32)
+    gmem = np.where(gmask, 16384, 0).astype(np.int32)
+    nodes = NodeView(*(jnp.asarray(a) for a in (
+        left, tot, ml, mt, ng, ng, gml, gmt, gmem, gmask,
+        np.ones(N, bool))))
+    return pod, nodes
+
+
+def _corpus():
+    fake = llm.FakeLLM(seed=3, junk_rate=0.0)
+    return (list(template.seed_policies().values())
+            + [template.fill_template(fake.complete("x")) for _ in range(30)])
+
+
+def test_corpus_lowers_and_matches_exactly():
+    """Every seed + FakeLLM candidate lowers to the VM, and interpreted
+    scores equal the transpiled policy's on randomized views."""
+    rng = np.random.default_rng(7)
+    score = jax.jit(vm.score)
+    lowered = 0
+    for code in _corpus():
+        policy = transpiler.transpile(code)
+        prog = vm.compile_policy(code, N, G, capacity=512)  # must not raise
+        lowered += 1
+        for _ in range(4):
+            pod, nodes = _rand_views(rng)
+            want = np.asarray(policy(pod, nodes))
+            got = np.asarray(score(prog, pod, nodes))
+            np.testing.assert_array_equal(got, want)
+    assert lowered == len(_corpus())
+
+
+def test_full_simulation_fitness_matches_jit_tier(default_workload):
+    """Seed candidates through the shared VM engine program reproduce the
+    reference fitness table exactly (first_fit 0.4292, best_fit 0.4465)."""
+    from fks_tpu.sim.engine import SimConfig, initial_state, make_param_run_fn
+
+    wl = default_workload
+    n, g = wl.cluster.n_padded, wl.cluster.g_padded
+    cfg = SimConfig(cond_policy=True)
+    run = jax.jit(make_param_run_fn(wl, vm.score, cfg))
+    s0 = initial_state(wl, cfg)
+    want = {"first_fit": 0.4292, "best_fit": 0.4465}
+    for name, code in template.seed_policies().items():
+        prog = vm.compile_policy(code, n, g, capacity=512)
+        res = run(prog, s0)
+        assert abs(float(res.policy_score) - want[name]) < 1e-4, name
+        assert int(res.scheduled_pods) == wl.num_pods
+
+
+def test_unsupported_construct_falls_back():
+    code = template.fill_template(
+        "gpus = sorted(g.gpu_milli_left for g in node.gpus)\n"
+        "return max(1, gpus[0]) if pod.num_gpu == 0 else 1")
+    transpiler.transpile(code)  # transpilable...
+    with pytest.raises(vm.VMUnsupported):
+        vm.compile_policy(code, N, G, capacity=512)  # ...but not VM-able
+
+
+def test_code_evaluator_uses_vm_tier(micro_workload_or_none=None):
+    from fks_tpu.data.build import make_workload
+
+    nodes = [{"node_id": "n0", "cpu_milli": 4000, "memory_mib": 8000,
+              "gpus": [1000, 1000]},
+             {"node_id": "n1", "cpu_milli": 2000, "memory_mib": 4000,
+              "gpus": []}]
+    pods = [{"pod_id": f"p{i}", "cpu_milli": 500, "memory_mib": 500,
+             "num_gpu": i % 2, "gpu_milli": 300 * (i % 2),
+             "creation_time": i, "duration_time": 5} for i in range(6)]
+    wl = make_workload(nodes, pods, pad_nodes_to=2, pad_gpus_to=2,
+                       pad_pods_to=8)
+    ev = backend.CodeEvaluator(wl)
+    seeds = list(template.seed_policies().values())
+    recs = ev.evaluate(seeds)
+    assert all(r.ok for r in recs)
+    assert ev.vm_count == len(seeds)
+    assert ev.compile_count == 0  # nothing hit the per-candidate jit tier
+
+    # and the jit tier still answers for VM-unsupported candidates
+    hard = template.fill_template(
+        "gpus = sorted(g.gpu_milli_left for g in node.gpus)\n"
+        "return max(1, gpus[0]) if pod.num_gpu == 0 else 1")
+    rec = ev.evaluate([hard])[0]
+    assert rec.ok
+    assert ev.compile_count == 1
+
+
+def test_vm_matches_jit_tier_scores():
+    """CodeEvaluator with and without the VM tier produce identical
+    fitness for the same candidates."""
+    from fks_tpu.data.build import make_workload
+
+    nodes = [{"node_id": "n0", "cpu_milli": 9000, "memory_mib": 9000,
+              "gpus": [1000] * 3},
+             {"node_id": "n1", "cpu_milli": 5000, "memory_mib": 5000,
+              "gpus": [1000]}]
+    pods = [{"pod_id": f"q{i}", "cpu_milli": 700, "memory_mib": 600,
+             "num_gpu": 1 if i % 3 else 0, "gpu_milli": 250 if i % 3 else 0,
+             "creation_time": i // 2, "duration_time": 4} for i in range(10)]
+    wl = make_workload(nodes, pods, pad_nodes_to=2, pad_gpus_to=3,
+                       pad_pods_to=16)
+    codes = _corpus()[:8]
+    with_vm = backend.CodeEvaluator(wl, use_vm=True).scores(codes)
+    without = backend.CodeEvaluator(wl, use_vm=False).scores(codes)
+    np.testing.assert_array_equal(with_vm, without)
