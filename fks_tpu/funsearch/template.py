@@ -61,8 +61,35 @@ def priority_function(pod, node):
 
 def fill_template(evolved_logic: str) -> str:
     """Insert the LLM-generated block at 4-space indentation (reference:
-    safe_execution.py:267-270)."""
-    return TEMPLATE.replace(LOGIC_PLACEHOLDER, evolved_logic.strip())
+    safe_execution.py:267-270).
+
+    The reference splices the stripped block verbatim, so continuation
+    lines must already carry their own 4-space base indent (the prompt
+    demands it). LLMs routinely emit the block at column 0 instead, which
+    the verbatim splice turns into a SyntaxError and a wasted candidate —
+    so when the verbatim fill does not parse, retry with every line after
+    the first shifted to the template's 4-space base. Contract-compliant
+    blocks are spliced byte-identically to the reference."""
+    import ast
+
+    logic = evolved_logic.strip()
+    code = TEMPLATE.replace(LOGIC_PLACEHOLDER, logic)
+    lines = logic.splitlines()
+    if len(lines) == 1:
+        return code
+    try:
+        ast.parse(code)
+        return code
+    except SyntaxError:
+        pass
+    shifted = "\n".join([lines[0]] + ["    " + l if l.strip() else l
+                                      for l in lines[1:]])
+    reindented = TEMPLATE.replace(LOGIC_PLACEHOLDER, shifted)
+    try:
+        ast.parse(reindented)
+        return reindented
+    except SyntaxError:
+        return code  # let validation report the original form
 
 
 _PREFIX, _SUFFIX = TEMPLATE.split(LOGIC_PLACEHOLDER)

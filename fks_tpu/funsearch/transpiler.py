@@ -97,6 +97,34 @@ class _Gpu:
         raise TranspileError(f"unknown gpu attribute {name!r}")
 
 
+class _SortedVals:
+    """``sorted(expr for gpu in node.gpus [if cond])`` — per-node ascending
+    values over the padded GPU axis. Masked-out slots sort to the tail via
+    a dtype-max sentinel; ``count[N]`` is the per-node live length, so
+    indexing can reproduce Python's IndexError as lane poison (the
+    reference maps the raised IndexError to candidate fitness 0,
+    funsearch_integration.py:63-64; here only the offending lanes refuse).
+    """
+
+    def __init__(self, vals, sel):
+        vals = jnp.asarray(vals)
+        if jnp.issubdtype(vals.dtype, jnp.integer):
+            big = jnp.iinfo(vals.dtype).max
+        else:
+            big = jnp.asarray(jnp.inf, vals.dtype)
+        self.vals = jnp.sort(jnp.where(sel, vals, big), axis=1)
+        self.count = jnp.sum(sel, axis=1).astype(jnp.int32)
+
+    def index(self, k: int, mask, interp):
+        gp = self.vals.shape[1]
+        if k >= 0:
+            interp.poison = interp.poison | (mask & (self.count <= k))
+            return self.vals[:, min(k, gp - 1)]
+        interp.poison = interp.poison | (mask & (self.count < -k))
+        idx = jnp.clip(self.count + k, 0, gp - 1)
+        return jnp.take_along_axis(self.vals, idx[:, None], axis=1)[:, 0]
+
+
 class _Node:
     FIELDS = ("cpu_milli_left", "cpu_milli_total", "memory_mib_left",
               "memory_mib_total", "gpu_left")
@@ -113,9 +141,34 @@ class _Node:
         return getattr(self._nodes, name)
 
 
+def _to_inexact(v):
+    """Float coercion matching the reference's numeric model: CPython
+    computes ``/`` and ``math.*`` in binary64 regardless of operand types
+    (reference: funsearch/safe_execution.py math whitelist), so integral
+    operands are promoted to the ambient float — f64 under x64 (tests,
+    golden parity), f32 otherwise (TPU). Without this, JAX's
+    ``to_inexact_dtype`` picks f32 for int32 operands and f64 for int64
+    ones even under x64, so the SAME candidate mixes precisions depending
+    on which entity field fed the expression — and the VM tier
+    (fks_tpu.funsearch.vm), which runs a single-dtype register model,
+    cannot reproduce the mix."""
+    a = jnp.asarray(v)
+    if jnp.issubdtype(a.dtype, jnp.inexact):
+        return a
+    return a.astype(jnp.float64 if _x64() else jnp.float32)
+
+
+def _mathfn(fn):
+    def go(*args):
+        return fn(*(_to_inexact(a) for a in args))
+    return go
+
+
 _MATH_FNS = {
-    "sqrt": jnp.sqrt, "log": jnp.log, "exp": jnp.exp, "pow": jnp.power,
-    "sin": jnp.sin, "cos": jnp.cos, "tan": jnp.tan,
+    "sqrt": _mathfn(jnp.sqrt), "log": _mathfn(jnp.log),
+    "exp": _mathfn(jnp.exp), "pow": _mathfn(jnp.power),
+    "sin": _mathfn(jnp.sin), "cos": _mathfn(jnp.cos),
+    "tan": _mathfn(jnp.tan),
 }
 
 
@@ -168,6 +221,11 @@ class _Interp:
         self.poison = jnp.zeros(self.n, bool)
         # per-variable "assigned on this lane" masks; absent = all lanes
         self.defined: Dict[str, Any] = {}
+        # syntactic conditional-nesting depth: 0 = function top level, where
+        # a statement executes on every lane that hasn't returned (masks
+        # become tracers after the first data-dependent return, so
+        # "unconditional" must be tracked syntactically, not by value)
+        self.cond_depth = 0
 
     # ----- statements
 
@@ -188,9 +246,13 @@ class _Interp:
             self.assign(st.target.id, val, mask)
         elif isinstance(st, ast.If):
             cond = _truthy(self.eval(st.test, mask))
-            self.run_block(st.body, mask & cond)
-            if st.orelse:
-                self.run_block(st.orelse, mask & ~cond)
+            self.cond_depth += 1
+            try:
+                self.run_block(st.body, mask & cond)
+                if st.orelse:
+                    self.run_block(st.orelse, mask & ~cond)
+            finally:
+                self.cond_depth -= 1
         elif isinstance(st, ast.Return):
             if st.value is None:
                 raise TranspileError("bare return not allowed")
@@ -216,10 +278,14 @@ class _Interp:
         if isinstance(it, _GpuList):
             if not isinstance(st.target, ast.Name):
                 raise TranspileError("gpu loop target must be a name")
-            for g in range(it.padded):
-                gmask = mask & self.nodes.gpu_mask[:, g] & ~self.returned
-                self.env[st.target.id] = _Gpu(self.nodes, g)
-                self.run_block(st.body, gmask)
+            self.cond_depth += 1  # bodies run under a per-lane gpu mask
+            try:
+                for g in range(it.padded):
+                    gmask = mask & self.nodes.gpu_mask[:, g] & ~self.returned
+                    self.env[st.target.id] = _Gpu(self.nodes, g)
+                    self.run_block(st.body, gmask)
+            finally:
+                self.cond_depth -= 1
             self.env.pop(st.target.id, None)
         elif isinstance(it, _EnumGpus):
             if not (isinstance(st.target, ast.Tuple)
@@ -227,11 +293,15 @@ class _Interp:
                     and all(isinstance(e, ast.Name) for e in st.target.elts)):
                 raise TranspileError("enumerate target must be `i, gpu`")
             iname, gname = (e.id for e in st.target.elts)
-            for g in range(it.gpus.padded):
-                gmask = mask & self.nodes.gpu_mask[:, g] & ~self.returned
-                self.env[iname] = g
-                self.env[gname] = _Gpu(self.nodes, g)
-                self.run_block(st.body, gmask)
+            self.cond_depth += 1
+            try:
+                for g in range(it.gpus.padded):
+                    gmask = mask & self.nodes.gpu_mask[:, g] & ~self.returned
+                    self.env[iname] = g
+                    self.env[gname] = _Gpu(self.nodes, g)
+                    self.run_block(st.body, gmask)
+            finally:
+                self.cond_depth -= 1
             self.env.pop(iname, None)
             self.env.pop(gname, None)
         elif isinstance(it, range):
@@ -273,6 +343,33 @@ class _Interp:
             raise TranspileError("cannot store entity objects in variables")
         active = mask & ~self.returned
         all_active = _statically_true(active)
+        if isinstance(self.env.get(name), _SortedVals) \
+                and not isinstance(val, _SortedVals):
+            # overwriting a list with a scalar/array: plain rebinding is
+            # fine when the statement executes on every lane that hasn't
+            # returned (returned lanes can never read the name again);
+            # a branch-local overwrite would need lane-wise blending of a
+            # list with a scalar, which has no meaning
+            if self.cond_depth != 0:
+                raise TranspileError(
+                    "cannot conditionally overwrite a sorted() list")
+            self.env[name] = val
+            self.defined.pop(name, None)
+            return
+        if isinstance(val, _SortedVals):
+            # the object holds data for EVERY lane, so a masked first
+            # assignment just records which lanes may legally read it
+            # (others poison on read, like any conditionally-bound name);
+            # lane-wise BLENDING of two different lists is meaningless
+            if name in self.env and not all_active:
+                raise TranspileError(
+                    "cannot conditionally reassign a sorted() list")
+            self.env[name] = val
+            if name in self.defined:
+                self.defined[name] = self.defined[name] | active
+            elif not all_active:
+                self.defined[name] = active
+            return
         if name in self.env:
             old = self.env[name]
             if isinstance(old, (int, float)) and isinstance(val, (int, float)) \
@@ -371,7 +468,35 @@ class _Interp:
             return _where(cond, a, b)
         if isinstance(node, ast.Call):
             return self.call(node, mask)
+        if isinstance(node, ast.Subscript):
+            return self.subscript(node, mask)
         raise TranspileError(f"unsupported expression {type(node).__name__}")
+
+    def subscript(self, node, mask):
+        base = self.eval(node.value, mask)
+        idx = node.slice
+        k: Optional[int] = None
+        if isinstance(idx, ast.Constant) and isinstance(idx.value, int) \
+                and not isinstance(idx.value, bool):
+            k = idx.value
+        elif isinstance(idx, ast.UnaryOp) and isinstance(idx.op, ast.USub) \
+                and isinstance(idx.operand, ast.Constant) \
+                and isinstance(idx.operand.value, int):
+            k = -idx.operand.value
+        if k is None:
+            raise TranspileError("subscripts must use a static integer index")
+        if isinstance(base, _SortedVals):
+            return base.index(k, mask, self)
+        if isinstance(base, _GpuList):
+            # node.gpus[k]: out-of-range lanes poison (Python IndexError)
+            if k < 0:
+                raise TranspileError("negative gpu index not supported")
+            if k >= base.padded:
+                self.poison = self.poison | mask
+                return _Gpu(self.nodes, 0)
+            self.poison = self.poison | (mask & ~self.nodes.gpu_mask[:, k])
+            return _Gpu(self.nodes, k)
+        raise TranspileError("subscript of unsupported value")
 
     def binop(self, op, a, b):
         both_py = isinstance(a, (int, float)) and isinstance(b, (int, float))
@@ -384,7 +509,7 @@ class _Interp:
         if isinstance(op, ast.Div):
             if both_py:
                 return a / b if b != 0 else math.inf  # lowered to refuse later
-            return jnp.asarray(a) / jnp.asarray(b)
+            return _to_inexact(a) / _to_inexact(b)
         if isinstance(op, ast.FloorDiv):
             if both_py:
                 return a // b if b != 0 else math.inf
@@ -436,6 +561,11 @@ class _Interp:
         if name in ("sum", "min", "max") and len(node.args) == 1 \
                 and isinstance(node.args[0], ast.GeneratorExp):
             return self.reduce_genexp(name, node.args[0], mask)
+        if name == "sorted":
+            if len(node.args) == 1 \
+                    and isinstance(node.args[0], ast.GeneratorExp):
+                return _SortedVals(*self.genexp_grid(node.args[0], mask))
+            raise TranspileError("sorted() only over a generator")
 
         args = [self.eval(a, mask) for a in node.args]
         _check_arity(name, len(args))
@@ -453,9 +583,9 @@ class _Interp:
             return out
         if name == "len":
             (a,) = args
-            if isinstance(a, _GpuList):
+            if isinstance(a, (_GpuList, _SortedVals)):
                 return a.count
-            raise TranspileError("len() only of node.gpus")
+            raise TranspileError("len() only of node.gpus or sorted(...)")
         if name == "int":
             (a,) = args
             if isinstance(a, (int, float)):
@@ -488,9 +618,9 @@ class _Interp:
             raise TranspileError("sum() only over a generator")
         raise TranspileError(f"call to unsupported function {name!r}")
 
-    def reduce_genexp(self, name, gen, mask):
-        """``sum/min/max(expr for gpu in node.gpus [if cond])`` -> masked
-        reduction over the padded GPU axis."""
+    def genexp_grid(self, gen, mask):
+        """Evaluate ``(expr for gpu in node.gpus [if cond])`` into
+        ``(vals[N, Gp], sel[N, Gp])`` over the padded GPU axis."""
         if len(gen.generators) != 1:
             raise TranspileError("single-clause generators only")
         comp = gen.generators[0]
@@ -517,6 +647,12 @@ class _Interp:
             self.env[tname] = saved
         vals = jnp.stack([jnp.broadcast_to(c, (self.n,)) for c in cols], axis=1)
         sel = jnp.stack(conds, axis=1)
+        return vals, sel
+
+    def reduce_genexp(self, name, gen, mask):
+        """``sum/min/max(expr for gpu in node.gpus [if cond])`` -> masked
+        reduction over the padded GPU axis."""
+        vals, sel = self.genexp_grid(gen, mask)
         if name == "sum":
             return jnp.sum(jnp.where(sel, vals, 0), axis=1)
         # Python min()/max() of an empty iterable raises (-> reference maps

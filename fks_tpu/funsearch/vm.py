@@ -21,10 +21,16 @@ Pipeline:
        so ONE compiled engine serves every candidate of that bucket.
 
 Execution (`score`): ``fori_loop`` over live ops, each a ``lax.switch``
-over ~25 opcodes on [N, G] values. Numeric model: everything f32; bools are
-0/1; integer ops are exact below 2**24 (trace resources are ≤ ~1e6; the
-reference's own champion scores are ≤ ~1e4, tests/test_scheduler.py).
-Integer division/remainder use C-style truncation exactly like lax.
+over ~25 opcodes on [N, G] values. Numeric model: everything runs at the
+AMBIENT float precision — f64 when x64 is on (CPU tests / golden parity,
+where the transpiler also computes floats in f64, matching the reference's
+CPython binary64), f32 otherwise (TPU, where the jit tier is f32 too).
+Keeping the two tiers at the same precision is what makes VM scores
+integer-exact against the transpiled policy: a trunc after an f32 division
+can land one short of the f64 result right at integer boundaries. Bools
+are 0/1; integer ops are exact below the mantissa (trace resources are
+≤ ~1e6). Integer division/remainder use C-style truncation exactly like
+lax.
 
 Candidates using constructs outside the lowerable vocabulary raise
 ``VMUnsupported`` — the caller falls back to the per-candidate jit tier
@@ -43,7 +49,10 @@ from jax import lax
 from fks_tpu.funsearch import transpiler
 from fks_tpu.sim.types import NodeView, PodView
 
-F = jnp.float32
+def _ambient_float():
+    """f64 under x64 (what the transpiled jit tier computes floats in
+    there), else f32. Evaluated at trace time, not import time."""
+    return jax.dtypes.canonicalize_dtype(np.float64)
 
 # --------------------------------------------------------------- input plan
 
@@ -477,7 +486,7 @@ def compile_policy(code: str, n: int, g: int,
         a=jnp.asarray(arr[1], jnp.int32),
         b=jnp.asarray(arr[2], jnp.int32),
         c=jnp.asarray(arr[3], jnp.int32),
-        imm=jnp.asarray(arr[4], F),
+        imm=jnp.asarray(arr[4], _ambient_float()),
         n_ops=jnp.asarray(n_ops, jnp.int32),
         out_reg=jnp.asarray(out_reg, jnp.int32),
     )
@@ -487,8 +496,9 @@ def compile_policy(code: str, n: int, g: int,
 
 
 def _inputs(pod: PodView, nodes: NodeView) -> jax.Array:
-    """[N_INPUTS, N, G] f32 broadcast input registers."""
+    """[N_INPUTS, N, G] ambient-float broadcast input registers."""
     n, g = nodes.gpu_mask.shape
+    F = _ambient_float()
 
     def full(x):
         return jnp.full((n, g), jnp.asarray(x, F))
@@ -504,6 +514,8 @@ def _inputs(pod: PodView, nodes: NodeView) -> jax.Array:
 
 
 def _branches(n: int, g: int):
+    F = _ambient_float()
+
     def red(fn):
         def go(va, vb, vc, im):
             return jnp.broadcast_to(fn(va, axis=1, keepdims=True), (n, g))
@@ -572,7 +584,7 @@ def score(prog: VMProgram, pod: PodView, nodes: NodeView) -> jax.Array:
     branches = _branches(n, g)
     inp = _inputs(pod, nodes)
     cap = prog.capacity
-    regs = jnp.concatenate([inp, jnp.zeros((cap, n, g), F)])
+    regs = jnp.concatenate([inp, jnp.zeros((cap, n, g), _ambient_float())])
 
     def body(k, regs):
         res = lax.switch(

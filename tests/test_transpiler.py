@@ -158,9 +158,9 @@ def test_nonfinite_lanes_refuse():
 
 
 @pytest.mark.parametrize("bad_logic", [
-    "score = sorted(node.gpus)",          # unsupported call result
+    "score = sorted(node.gpus)",          # sorted() of a non-generator
     "for i in range(1000000):\n        score = 1",  # unbounded unroll
-    "score = node.gpus[0].gpu_milli_left",  # subscript not lowered
+    "score = node.gpus[pod.num_gpu].gpu_milli_left",  # dynamic subscript
     "score = pod.nonexistent_field",
     "score = abs()",                      # wrong arity must not escape
     "score = min(5)",
@@ -179,6 +179,46 @@ def _lane_scores(logic, rng_seed=11):
     rng = np.random.default_rng(rng_seed)
     pod, nodes, spod, snodes = random_state(rng)
     return code, np.asarray(policy(pod, nodes)), spod, snodes
+
+
+@pytest.mark.parametrize("logic", [
+    # sorted() over a generator + static indexing, against the scalar
+    # oracle (reference whitelists `sorted`, safe_execution.py:19-22)
+    "gpus = sorted(g.gpu_milli_left for g in node.gpus)\n"
+    "score = gpus[0] + 1",
+    "gpus = sorted(g.gpu_milli_left for g in node.gpus)\n"
+    "score = gpus[-1] + 2 * len(gpus)",
+    "score = node.gpus[1].gpu_milli_left + 3",
+])
+def test_sorted_and_subscript_match_oracle(logic):
+    """Lanes where Python would raise (IndexError on short lists) refuse;
+    every other lane matches the reference-style scalar evaluation."""
+    code, got, spod, snodes = _lane_scores(logic)
+    fn = sandbox.compile_policy(code)
+    for i, sn in enumerate(snodes):
+        try:
+            want = int(fn(spod, sn))
+        except Exception:
+            want = 0
+        assert got[i] == want, (i, logic)
+
+
+def test_sorted_list_overwritten_by_scalar():
+    """Regression: rebinding a name that held a sorted() list must not
+    crash the transpiler; unconditional rebinding works, conditional
+    rebinding is cleanly rejected (outside the lowerable subset)."""
+    code, got, spod, snodes = _lane_scores(
+        "xs = sorted(g.gpu_milli_left for g in node.gpus)\n"
+        "xs = 7.0\n"
+        "score = xs")
+    fn = sandbox.compile_policy(code)
+    assert got.tolist() == [int(fn(spod, sn)) for sn in snodes]
+    with pytest.raises(transpiler.TranspileError):
+        transpiler.transpile(template.fill_template(
+            "xs = sorted(g.gpu_milli_left for g in node.gpus)\n"
+            "if pod.num_gpu > 0:\n"
+            "        xs = 1.0\n"
+            "score = 1"))
 
 
 def test_empty_generator_minmax_poisons_lane():
