@@ -4,21 +4,35 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 
 What is measured: the full reference workload (16 nodes x 8,152 pods,
 reference: benchmarks/traces/csv/openb_pod_list_default.csv) evaluated for a
-population of parametric scheduling policies as a single vmapped XLA
-program — the unit of work the reference performs per candidate in its
+population of parametric scheduling policies as vmapped XLA programs — the
+unit of work the reference performs per candidate in its
 ProcessPoolExecutor (reference: funsearch/funsearch_integration.py:30-64:
 re-parse trace, deep-copy state, run the Python event loop, ~0.2 s/eval,
 SURVEY.md §6). Baseline: the reference's best implied throughput on its own
 benchmark, max_workers(8) / 0.2 s = 40 evals/s/host.
 
-A fitness-parity gate runs first (first_fit == 0.4292 etc. to 1e-4 — the
-table publishes 4 decimals and the device runs float32,
-reference README.md:25-31 table); the benchmark refuses to report a number
-from a simulator that disagrees with the reference.
+Two-stage protocol:
+1. PARITY GATE (exact engine, fks_tpu.sim.engine): first_fit/best_fit/
+   funsearch_4901 fitness must reproduce the reference table to 1e-4 —
+   the benchmark refuses to report from a simulator that disagrees with
+   the reference. The exact engine replicates the reference bit-for-bit
+   including its heap-layout-dependent retry rule.
+2. THROUGHPUT (flat engine, fks_tpu.sim.flat, by default): the slot-per-pod
+   event queue the TPU likes — identical semantics except the documented
+   retry-time rule (time-order next deletion; measured fitness deltas on
+   the published policies <= 0.029, tests/test_flat_engine.py). The flat
+   engine's own best_fit score is additionally checked against the
+   reference value to 2e-2 before timing.
 
-Env knobs: FKS_BENCH_POP (population size, default 16 — the axon TPU tunnel
-kills device executions past ~60 s, which caps the per-call batch), and
-FKS_BENCH_REPS (timed repetitions, default 3).
+The population is evaluated in chunks so no single device execution
+exceeds the axon tunnel's ~60 s kill window; throughput = total evals /
+total wall time across chunks (compile excluded; the compiled program is
+reused by every chunk and every later generation).
+
+Env knobs: FKS_BENCH_POP (total population, default 1024),
+FKS_BENCH_CHUNK (per-device-call lanes, default 256),
+FKS_BENCH_REPS (timed repetitions, default 2),
+FKS_BENCH_ENGINE (flat|exact, default flat).
 """
 import json
 import os
@@ -37,23 +51,27 @@ def log(*a):
 
 def main():
     import jax
-    import jax.numpy as jnp
 
     from fks_tpu.data import TraceParser
     from fks_tpu.models import parametric, zoo
     from fks_tpu.parallel import make_population_eval
+    from fks_tpu.sim import flat
     from fks_tpu.sim.engine import SimConfig, simulate
 
-    pop_size = int(os.environ.get("FKS_BENCH_POP", "16"))
-    reps = int(os.environ.get("FKS_BENCH_REPS", "3"))
+    pop = int(os.environ.get("FKS_BENCH_POP", "1024"))
+    chunk = int(os.environ.get("FKS_BENCH_CHUNK", "256"))
+    reps = int(os.environ.get("FKS_BENCH_REPS", "2"))
+    engine = os.environ.get("FKS_BENCH_ENGINE", "flat")
+    chunk = min(chunk, pop)
     dev = jax.devices()[0]
-    log(f"device: {dev.platform} ({dev.device_kind}); pop={pop_size} reps={reps}")
+    log(f"device: {dev.platform} ({dev.device_kind}); "
+        f"pop={pop} chunk={chunk} reps={reps} engine={engine}")
 
     wl = TraceParser().parse_workload()
     log(f"workload: {wl.num_nodes} nodes x {wl.num_pods} pods")
 
-    # ---- parity gate (scores are float32 on device; 1e-4 absolute covers
-    # the README's 4-digit reporting precision)
+    # ---- stage 1: parity gate on the exact engine (scores are float32 on
+    # device; 1e-4 absolute covers the README's 4-digit precision)
     for name, want in PARITY.items():
         got = float(simulate(wl, zoo.ZOO[name]()).policy_score)
         if abs(got - want) > 1e-4:
@@ -65,30 +83,54 @@ def main():
             return 1
         log(f"parity ok {name}: {got:.4f}")
 
-    # ---- throughput: one vmapped program evaluating the whole population
+    # flat-engine sanity: same trace, documented-retry-rule engine must
+    # stay near the reference table (see module docstring)
+    if engine == "flat":
+        got = float(flat.simulate(wl, zoo.ZOO["best_fit"]()).policy_score)
+        if abs(got - PARITY["best_fit"]) > 2e-2:
+            log(f"FLAT SANITY FAIL best_fit: {got:.4f}")
+            print(json.dumps({
+                "metric": "candidate policy evaluations/sec (8152-pod trace)",
+                "value": 0.0, "unit": "evals/s", "vs_baseline": 0.0,
+                "error": "flat-engine sanity check failed"}))
+            return 1
+        log(f"flat sanity ok best_fit: {got:.4f} (exact {PARITY['best_fit']})")
+
+    # ---- stage 2: throughput, chunked population
     key = jax.random.PRNGKey(0)
-    params = parametric.init_population(key, pop_size, noise=0.1)
-    ev = make_population_eval(wl, cfg=SimConfig())
+    params = parametric.init_population(key, pop, noise=0.1)
+    ev = make_population_eval(wl, cfg=SimConfig(), engine=engine)
+
     t0 = time.perf_counter()
-    res = ev(params)
+    res = ev(params[:chunk])
     jax.block_until_ready(res.policy_score)
     t_compile = time.perf_counter() - t0
-    log(f"first call (compile+run): {t_compile:.1f}s; "
-        f"scores [{float(jnp.min(res.policy_score)):.3f}, "
-        f"{float(jnp.max(res.policy_score)):.3f}]")
+    log(f"first chunk (compile+run): {t_compile:.1f}s; scores "
+        f"[{float(np.min(res.policy_score)):.3f}, "
+        f"{float(np.max(res.policy_score)):.3f}]")
 
-    from fks_tpu.utils import ThroughputMeter, block_timed
-
-    meter = ThroughputMeter()
     times = []
     for _ in range(reps):
-        _, secs = block_timed(ev, params)
-        times.append(secs)
-        meter.add(pop_size, secs)
+        t0 = time.perf_counter()
+        done = 0
+        while done < pop:
+            lo, hi = done, min(done + chunk, pop)
+            n = hi - lo
+            # chunks must share the compiled program: slice then pad to
+            # the chunk width instead of re-jitting a smaller batch
+            batch = params[lo:hi]
+            if n < chunk:
+                batch = np.concatenate(
+                    [np.asarray(batch),
+                     np.asarray(params[:chunk - n])], axis=0)
+            r = ev(batch)
+            jax.block_until_ready(r.policy_score)
+            done = hi
+        times.append(time.perf_counter() - t0)
     best = min(times)
-    evals_per_sec = pop_size / best
-    log(f"steady-state: {best:.3f}s / {pop_size} evals; aggregate "
-        f"{meter.summary()} (all reps: {[round(t, 3) for t in times]})")
+    evals_per_sec = pop / best
+    log(f"steady-state: {best:.3f}s / {pop} evals "
+        f"({[round(t, 3) for t in times]})")
 
     print(json.dumps({
         "metric": "candidate policy evaluations/sec (8152-pod trace)",

@@ -8,7 +8,10 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
-_BIG = jnp.int32(2**30)
+# plain int, NOT jnp.int32(...): a module-level jnp scalar would initialize
+# a backend at import time (this module is imported before callers get a
+# chance to pin jax_platforms — e.g. __graft_entry__.dryrun_multichip)
+_BIG = 2**30
 
 
 def best_fit_gpus(milli_left, gpu_mask, gpu_milli_req, num_gpu):

@@ -183,10 +183,18 @@ def _top_k_real(global_scores, real_count, k):
 # covered by the sharded-vs-vmap parity tests instead.
 
 
+def _engine_runner(workload, param_policy, cfg, engine):
+    """(population run fn, initial state) for the chosen engine."""
+    from fks_tpu.sim import get_engine
+    mod = get_engine(engine)
+    return (mod.make_population_run_fn(workload, param_policy, cfg),
+            mod.initial_state(workload, cfg))
+
+
 def make_sharded_eval(workload: Workload, mesh: Mesh,
                       param_policy: ParamPolicyFn = parametric.score,
                       cfg: SimConfig = SimConfig(),
-                      elite_k: int = 8):
+                      elite_k: int = 8, engine: str = "exact"):
     """Build ``eval(params[C, F], real_count) -> (scores[C], elite_idx[K],
     elite_scores[K])``.
 
@@ -198,8 +206,7 @@ def make_sharded_eval(workload: Workload, mesh: Mesh,
     set used for parent sampling and truncation (reference semantics: sort
     desc + take elite_size, funsearch_integration.py:494-496).
     """
-    run = make_population_run_fn(workload, param_policy, cfg)
-    state0 = initial_state(workload, cfg)
+    run, state0 = _engine_runner(workload, param_policy, cfg, engine)
     axes = _pop_axes(mesh)
 
     @functools.partial(
@@ -227,7 +234,8 @@ def make_sharded_generation_step(workload: Workload, mesh: Mesh,
                                  param_policy: ParamPolicyFn = parametric.score,
                                  cfg: SimConfig = SimConfig(),
                                  elite_k: int = 4,
-                                 noise: float = 0.05):
+                                 noise: float = 0.05,
+                                 engine: str = "exact"):
     """One full on-device evolution generation for parametric populations:
     evaluate (sharded) -> all-gather fitness -> top-k elites -> mutate
     offspring from elites. This is the framework's "training step" — the
@@ -240,8 +248,7 @@ def make_sharded_generation_step(workload: Workload, mesh: Mesh,
     ``pop``. Forward ``pad_population``'s ``real_count`` so pad duplicates
     never win elite slots.
     """
-    run = make_population_run_fn(workload, param_policy, cfg)
-    state0 = initial_state(workload, cfg)
+    run, state0 = _engine_runner(workload, param_policy, cfg, engine)
     axes = _pop_axes(mesh)
 
     @functools.partial(

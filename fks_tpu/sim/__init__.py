@@ -1,0 +1,28 @@
+"""Simulation engines.
+
+Two engines share one semantics module (evaluator arithmetic, finalize,
+SimConfig) and one policy interface (PodView/NodeView):
+
+- ``exact`` (fks_tpu.sim.engine): replicates the reference bit-for-bit,
+  including its heap-layout-dependent retry rule — the parity/golden path;
+- ``flat`` (fks_tpu.sim.flat): the TPU throughput engine (slot-per-pod
+  event queue; documented retry-rule divergence, see its module docstring
+  and PROFILE.md).
+
+``get_engine(name)`` is the single dispatch point — every caller that
+offers an engine choice (population eval, mesh eval, code backend, CLI)
+resolves the name here, so adding an engine is a one-place change.
+"""
+
+
+def get_engine(name: str):
+    """Engine module for ``name`` ("exact" | "flat"). Both modules expose
+    the same surface: initial_state, build_step, lane_active, finalize,
+    make_run_fn, make_param_run_fn, make_population_run_fn, simulate."""
+    if name == "exact":
+        from fks_tpu.sim import engine
+        return engine
+    if name == "flat":
+        from fks_tpu.sim import flat
+        return flat
+    raise ValueError(f"unknown engine {name!r}; expected 'exact' or 'flat'")

@@ -279,9 +279,13 @@ def build_step(workload: Workload, policy: PolicyFn, cfg: SimConfig,
 
         violations = s.violations
         if cfg.validate_invariants:
+            hi = jnp.arange(heap3.pod.shape[0])
+            pend_del = (hi < heap3.size) & (heap3.kind == jnp.int8(KIND_DELETE))
+            active_pods = jnp.zeros(
+                s.assigned_node.shape[0], bool).at[heap3.pod].max(pend_del)
             violations = violations + active.astype(jnp.int32) * _audit(
-                c, p, heap3, cpu_left, mem_left, gpu_left, gpu_milli_left,
-                assigned_node, assigned_gpus)
+                c, p, active_pods, cpu_left, mem_left, gpu_left,
+                gpu_milli_left, assigned_node, assigned_gpus)
 
         return SimState(
             heap=heap3, cpu_left=cpu_left, mem_left=mem_left,
@@ -297,13 +301,14 @@ def build_step(workload: Workload, policy: PolicyFn, cfg: SimConfig,
     return step
 
 
-def _audit(c: ClusterArrays, p: PodArrays, heap, cpu_left, mem_left,
+def _audit(c: ClusterArrays, p: PodArrays, active_pods, cpu_left, mem_left,
            gpu_left, gpu_milli_left, assigned_node, assigned_gpus):
     """Opt-in full-state audit after every event — the reference's
     invariant checker semantics (reference: simulator/main.py:201-272):
     non-negative remnants, remnant <= total, and conservation
     (used == total - remaining) at node and per-GPU granularity,
-    cross-checked against the pods whose DELETE is still pending.
+    cross-checked against ``active_pods`` — the engine's "DELETE still
+    pending" mask (heap-derived here, slot-derived in the flat engine).
     Returns i32 1 if any invariant fails at this step.
 
     The reference raises on first violation; a jitted loop cannot, so
@@ -320,11 +325,7 @@ def _audit(c: ClusterArrays, p: PodArrays, heap, cpu_left, mem_left,
             | jnp.any(nm & (gpu_left > c.gpu_declared))
             | jnp.any(c.gpu_mask & (gpu_milli_left > c.gpu_milli_total)))
 
-    # pods currently occupying resources = pods with a pending DELETE event
-    hi = jnp.arange(heap.pod.shape[0])
-    pending_delete = (hi < heap.size) & (heap.kind == jnp.int8(KIND_DELETE))
-    active = jnp.zeros(pp, bool).at[heap.pod].max(pending_delete)
-    active = active & (assigned_node >= 0)
+    active = active_pods & (assigned_node >= 0)
     seg = jnp.clip(assigned_node, 0, n - 1)
 
     def used_by_node(req):
@@ -349,8 +350,12 @@ def _gpu_count_used(c: ClusterArrays, gpu_left):
     return jnp.sum(c.num_gpus - gpu_left)
 
 
-def finalize(workload: Workload, cfg: SimConfig, s: SimState) -> SimResult:
-    """Fitness + results (reference evaluator.py:77-127)."""
+def finalize_fields(workload: Workload, cfg: SimConfig, *, pending, s) -> SimResult:
+    """Fitness + results (reference evaluator.py:77-127) from any engine
+    state carrying the shared evaluator fields. ``pending`` is that
+    engine's "events remain unprocessed" predicate (the exact engine's
+    heap size, the flat engine's live-slot test) — sharing everything else
+    keeps the two engines' fitness semantics identical by construction."""
     p = workload.pods
     f = cfg.score_dtype
     pod_mask = jnp.asarray(p.pod_mask)
@@ -361,7 +366,7 @@ def finalize(workload: Workload, cfg: SimConfig, s: SimState) -> SimResult:
         s.frag_count > 0, s.frag_sum / jnp.maximum(s.frag_count, 1).astype(f),
         jnp.asarray(0, f))
     all_assigned = jnp.all((s.assigned_node >= 0) | ~pod_mask)
-    truncated = (s.heap.size > 0) & ~s.failed
+    truncated = pending & ~s.failed
     overall = jnp.sum(avg) / 4
     raw = jnp.clip(overall - jnp.minimum(jnp.asarray(0.1, f), frag_mean), 0.0, 1.0)
     score = jnp.where(
@@ -381,6 +386,11 @@ def finalize(workload: Workload, cfg: SimConfig, s: SimState) -> SimResult:
         gpu_milli_left=s.gpu_milli_left, failed=s.failed, truncated=truncated,
         invariant_violations=s.violations,
     )
+
+
+def finalize(workload: Workload, cfg: SimConfig, s: SimState) -> SimResult:
+    """Fitness + results (reference evaluator.py:77-127)."""
+    return finalize_fields(workload, cfg, pending=s.heap.size > 0, s=s)
 
 
 def make_param_run_fn(workload: Workload, param_policy, cfg: SimConfig = SimConfig()):

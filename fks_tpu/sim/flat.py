@@ -1,0 +1,410 @@
+"""The flat (slot-per-pod) event-queue engine — the TPU throughput path.
+
+Why a second engine: the exact engine (fks_tpu.sim.engine) replicates the
+reference's CPython heap bit-for-bit (required for the layout-dependent
+retry rule, reference: simulator/event_simulator.py:51-58), but heap sifts
+are chains of ~14 dependent tiny gather/scatters per event — measured at
+~11 us/lane/step on a v5e chip, they dominate the step and scale LINEARLY
+with the vmapped population (tools/profile_step.py; PROFILE.md). TPUs are
+throughput machines: they want contiguous slices and vector reduces, not
+pointer-chasing.
+
+This engine replaces the heap with a structure a TPU likes:
+
+- **One slot per pod.** At any instant a pod has at most ONE pending event
+  (its CREATE, a retried CREATE, or its DELETE) — so the queue is just
+  ``ev_time[P]`` + ``ev_kind[P]``, and every step rewrites exactly one
+  slot. No sifts, no layout.
+- **Two-level min hierarchy.** Pop = lexicographic argmin over
+  ``(time, tie_rank)``. Slots are grouped into B blocks of ``block`` pods;
+  the carry holds each block's (min time, min rank) and min pending-DELETE
+  time. A step touches one block: one contiguous ``dynamic_slice`` in,
+  in-register recompute, one contiguous ``dynamic_update_slice`` out.
+  Per-step HBM traffic is O(block), independent of P.
+- **Pop order is EXACTLY the reference's** wherever the reference's own
+  order is well-defined: keys ``(time, tie_rank)`` are unique per pod
+  (tie_rank = pod-id rank, event_simulator.py:16-17), and a pod's CREATE
+  always precedes its own DELETE because the DELETE only enters the queue
+  when the CREATE is placed (event_simulator.py:45-49).
+
+Divergence from the reference, by design (SURVEY.md §7 explicitly blesses
+this): the retry time for an unplaceable pod is ``1 + (earliest pending
+DELETE time)`` instead of ``1 + (first DELETE in raw heap-ARRAY order)``,
+which is an artifact of CPython heapq's layout. Instrumenting the
+reference shows its scan lands on the time-earliest pending delete in the
+median case (mean rank 0.8), so the time-order rule is both principled
+AND the closest match; residual fitness deltas on the default trace's
+published policies are chaotic (any single different retry snowballs) and
+measured at |d| <= 0.029 (PROFILE.md).
+Everything else (placement, refunds, fragmentation, snapshot overshoot,
+fitness) is shared with or identical to the exact engine, so:
+
+- runs with ZERO failed placements are bit-identical to the exact engine
+  (and therefore to the reference) — enforced by differential tests;
+- runs with retries differ only in retry timing; the exact engine remains
+  the parity/golden path (bench.py's parity gate uses it).
+
+Like the reference, a pod that fails placement when NO deletion is pending
+is silently dropped (event_simulator.py:51-58 falls through) -> unassigned
+-> fitness 0.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fks_tpu.data.entities import Workload
+from fks_tpu.ops.allocator import best_fit_gpus, first_fit_gpus
+from fks_tpu.sim.engine import (
+    SimConfig, _audit, _node_view, finalize_fields, loop_tables,
+)
+from fks_tpu.sim.types import FlatState, NodeView, PodView, PolicyFn, SimResult
+
+INF = jnp.iinfo(jnp.int32).max  # empty-slot sentinel (also "rank" filler)
+
+K_CREATE = 0   # original creation event
+K_DELETE = 1   # pending deletion of a placed pod
+K_RETRY = 2    # re-queued creation (pod is in the waiting set)
+
+
+def _block_width(p_padded: int) -> int:
+    return min(128, max(1, p_padded))
+
+
+def _queue_size(p_padded: int) -> int:
+    """Slot-array length: p_padded rounded up to a whole number of blocks.
+    The queue pads internally (INF slots) so ANY workload padding works —
+    callers are not required to pad pod counts to a block multiple."""
+    bw = _block_width(p_padded)
+    return ((p_padded + bw - 1) // bw) * bw
+
+
+def _block_mins(bt, bk, br):
+    """(min time, rank at that min, min DELETE time) of one block slice.
+    Lexicographic (time, rank): ranks are unique, so the pair is unique."""
+    mt = jnp.min(bt)
+    mr = jnp.min(jnp.where(bt == mt, br, INF))
+    mdel = jnp.min(jnp.where(bk == K_DELETE, bt, INF))
+    return mt, mr, mdel
+
+
+def initial_state(workload: Workload, cfg: SimConfig) -> FlatState:
+    """t=0 carry: every real pod's slot holds its CREATE event."""
+    c, p = workload.cluster, workload.pods
+    pp = p.p_padded
+    qp = _queue_size(pp)
+    bw = _block_width(pp)
+    pm = np.asarray(p.pod_mask)
+    ev_time = np.full(qp, INF, np.int32)
+    ev_time[:pp] = np.where(pm, np.asarray(p.creation_time), INF)
+    ev_kind = np.zeros(qp, np.int32)
+    rank = np.full(qp, INF, np.int32)
+    rank[:pp] = np.where(pm, np.asarray(p.tie_rank), INF)
+    tb = ev_time.reshape(-1, bw)
+    rb = rank.reshape(-1, bw)
+    bmin_t = tb.min(axis=1)
+    bmin_r = np.where(tb == bmin_t[:, None], rb, INF).min(axis=1)
+
+    max_milli = int(np.asarray(p.gpu_milli).max(initial=0))
+    hist_size = (cfg.wait_hist_size if cfg.wait_hist_size is not None
+                 else max(1001, max_milli + 2))
+    if hist_size <= max_milli:
+        raise ValueError(
+            f"wait_hist_size {hist_size} <= trace max gpu_milli; "
+            "fragmentation min_needed would be miscounted")
+    f = cfg.score_dtype
+    return FlatState(
+        ev_time=jnp.asarray(ev_time),
+        ev_kind=jnp.asarray(ev_kind),
+        bmin_t=jnp.asarray(bmin_t, jnp.int32),
+        bmin_r=jnp.asarray(bmin_r, jnp.int32),
+        bdel_t=jnp.full(bmin_t.shape, INF, jnp.int32),
+        cpu_left=jnp.asarray(c.cpu_total, jnp.int32),
+        mem_left=jnp.asarray(c.mem_total, jnp.int32),
+        gpu_left=jnp.asarray(c.gpu_declared, jnp.int32),
+        gpu_milli_left=jnp.asarray(c.gpu_milli_total, jnp.int32),
+        assigned_node=jnp.full(pp, -1, jnp.int32),
+        assigned_gpus=jnp.zeros(pp, jnp.uint32),
+        pod_ctime=jnp.asarray(p.creation_time, jnp.int32),
+        wait_hist=jnp.zeros(hist_size, jnp.int32),
+        events_processed=jnp.int32(0),
+        snap_idx=jnp.int32(0),
+        snap_sums=jnp.zeros(4, f),
+        frag_sum=jnp.asarray(0, f),
+        frag_count=jnp.int32(0),
+        max_nodes=jnp.int32(0),
+        failed=jnp.bool_(False),
+        steps=jnp.int32(0),
+        violations=jnp.int32(0),
+    )
+
+
+def lane_active(s: FlatState, max_steps: int):
+    """Termination predicate (single source of truth for the loop cond and
+    the step's self-masking, like engine.lane_active).
+
+    The block-min reduction is over the LAST axis only: on the batched
+    state ``bmin_t`` is [lanes, B] and the predicate must stay per-lane —
+    a full reduction would let one truncated lane (pending events, step
+    budget exhausted) hold the population loop's cond true through other
+    lanes forever."""
+    return ((jnp.min(s.bmin_t, axis=-1) < INF)
+            & ~s.failed & (s.steps < max_steps))
+
+
+def build_step(workload: Workload, policy: PolicyFn, cfg: SimConfig,
+               ktable, max_steps: int) -> Callable[[FlatState], FlatState]:
+    """One event. Self-masking like the exact engine's step, so the
+    population layer can run ONE while_loop over vmapped lanes."""
+    c, p = workload.cluster, workload.pods
+    c = jax.tree_util.tree_map(jnp.asarray, c)
+    p = jax.tree_util.tree_map(jnp.asarray, p)
+    pp = p.p_padded
+    qp = _queue_size(pp)
+    bw = _block_width(pp)
+    g = workload.cluster.g_padded
+    f = cfg.score_dtype
+    alloc = best_fit_gpus if cfg.gpu_allocator == "best_fit" else first_fit_gpus
+    total_cpu = jnp.sum(c.cpu_total)
+    total_mem = jnp.sum(c.mem_total)
+    total_gc = jnp.sum(c.num_gpus)
+    total_gm = jnp.sum(c.gpu_milli_total)
+    g_iota = jnp.arange(g, dtype=jnp.uint32)
+    bw_iota = jnp.arange(bw, dtype=jnp.int32)
+    ktable = jnp.asarray(ktable, jnp.int32)
+    klen = ktable.shape[0]
+    rank_arr = jnp.full(qp, INF, jnp.int32).at[:pp].set(
+        jnp.where(p.pod_mask, p.tie_rank, INF).astype(jnp.int32))
+
+    def step(s: FlatState) -> FlatState:
+        active = lane_active(s, max_steps)
+
+        # ---- pop: two-level lexicographic argmin over (time, rank)
+        gt = jnp.min(s.bmin_t)
+        cand = s.bmin_t == gt
+        gr = jnp.min(jnp.where(cand, s.bmin_r, INF))
+        b = jnp.argmax(cand & (s.bmin_r == gr)).astype(jnp.int32)
+        start = b * bw
+        bt = jax.lax.dynamic_slice_in_dim(s.ev_time, start, bw)
+        bk = jax.lax.dynamic_slice_in_dim(s.ev_kind, start, bw)
+        br = jax.lax.dynamic_slice_in_dim(rank_arr, start, bw)
+        off = jnp.argmax((bt == gt) & (br == gr)).astype(jnp.int32)
+        pod = start + off
+        t = gt
+        kind = bk[off]
+        is_del = active & (kind == K_DELETE)
+        create = active & (kind != K_DELETE)
+        was_waiting = kind == K_RETRY
+
+        pcpu = p.cpu[pod]
+        pmem = p.mem[pod]
+        pngpu = p.num_gpu[pod]
+        pmilli = p.gpu_milli[pod]
+        pdur = p.duration[pod]
+
+        # ---- DELETION: refund resources (reference main.py:74-99)
+        a = jnp.where(is_del, s.assigned_node[pod], 0)
+        di = is_del.astype(jnp.int32)
+        cpu_left = s.cpu_left.at[a].add(di * pcpu)
+        mem_left = s.mem_left.at[a].add(di * pmem)
+        gpu_left = s.gpu_left.at[a].add(di * pngpu)
+        bits = s.assigned_gpus[pod]
+        sel_bits = ((bits >> g_iota) & 1).astype(jnp.int32)  # [G]
+        gpu_milli_left = s.gpu_milli_left.at[a].add(di * pmilli * sel_bits)
+
+        # ---- CREATION: strict argmax placement (main.py:101-111)
+        pod_view = PodView(pcpu, pmem, pngpu, pmilli, t, pdur)
+        node_view = _node_view(c, cpu_left, mem_left, gpu_left, gpu_milli_left)
+        if cfg.cond_policy:
+            out = jax.eval_shape(policy, pod_view, node_view)
+            raw_scores = jax.lax.cond(
+                create, lambda: jnp.asarray(policy(pod_view, node_view)),
+                lambda: jnp.zeros(out.shape, out.dtype))
+        else:
+            raw_scores = policy(pod_view, node_view)
+        scores = jnp.where(c.node_mask, raw_scores, 0)
+        w = jnp.argmax(scores).astype(jnp.int32)
+        placed = create & (scores[w] > 0)
+
+        sel, ok = alloc(gpu_milli_left[w], c.gpu_mask[w], pmilli, pngpu)
+        alloc_fail = placed & (pngpu > 0) & ~ok  # reference raises here
+        pl = placed & ~alloc_fail
+        pli = pl.astype(jnp.int32)
+        cpu_left = cpu_left.at[w].add(-pli * pcpu)
+        mem_left = mem_left.at[w].add(-pli * pmem)
+        gpu_left = gpu_left.at[w].add(-pli * pngpu)
+        gpu_milli_left = gpu_milli_left.at[w].add(
+            -pli * pmilli * sel.astype(jnp.int32))
+
+        assigned_node = s.assigned_node.at[pod].set(
+            jnp.where(pl, w, s.assigned_node[pod]))
+        new_bits = jnp.sum(jnp.where(sel, jnp.uint32(1) << g_iota,
+                                     jnp.uint32(0)), dtype=jnp.uint32)
+        assigned_gpus = s.assigned_gpus.at[pod].set(
+            jnp.where(pl, new_bits, bits))
+
+        # ---- failed creation: waiting set + fragmentation + retry
+        failp = create & ~placed
+        bucket = jnp.clip(pmilli, 0, s.wait_hist.shape[0] - 1)
+        hist = s.wait_hist.at[bucket].add(
+            (failp & ~was_waiting & (pngpu > 0)).astype(jnp.int32)
+            - (pl & was_waiting & (pngpu > 0)).astype(jnp.int32))
+
+        hvals = hist > 0
+        has_gpu_waiting = jnp.any(hvals)
+        min_needed = jnp.argmax(hvals).astype(jnp.int32)
+        frag_free = jnp.where(
+            c.gpu_mask & (gpu_milli_left > 0) & (gpu_milli_left < min_needed),
+            gpu_milli_left, 0)
+        frag_score = jnp.where(
+            has_gpu_waiting & (total_gm > 0),
+            jnp.sum(frag_free, dtype=jnp.int32).astype(f)
+            / jnp.maximum(total_gm, 1).astype(f),
+            jnp.asarray(0, f))
+        frag_sum = s.frag_sum + jnp.where(failp, frag_score, 0)
+        frag_count = s.frag_count + failp.astype(jnp.int32)
+
+        # retry rule (defined semantics; see module docstring): 1 + the
+        # EARLIEST pending DELETE time. Instrumenting the reference shows
+        # its array-order scan picks the time-earliest pending delete in
+        # the median case (mean rank 0.8 among pending deletes; measured
+        # on the default trace), so this is also the closest principled
+        # approximation of the reference's cadence.
+        next_del = jnp.min(s.bdel_t)
+        found = next_del < INF
+        retry = failp & found
+        rt = next_del + 1
+        pod_ctime = s.pod_ctime.at[pod].set(
+            jnp.where(retry, rt, s.pod_ctime[pod]))
+
+        # ---- slot rewrite: the popped pod's next event
+        new_t = jnp.where(pl, t + pdur, jnp.where(retry, rt, INF))
+        new_k = jnp.where(pl, K_DELETE, K_RETRY)
+        bt2 = jnp.where(active & (bw_iota == off), new_t, bt)
+        bk2 = jnp.where(active & (bw_iota == off), new_k, bk)
+        ev_time = jax.lax.dynamic_update_slice_in_dim(s.ev_time, bt2, start, 0)
+        ev_kind = jax.lax.dynamic_update_slice_in_dim(s.ev_kind, bk2, start, 0)
+        mt, mr, mdel = _block_mins(bt2, bk2, br)
+        upd = active
+        bmin_t = s.bmin_t.at[b].set(jnp.where(upd, mt, s.bmin_t[b]))
+        bmin_r = s.bmin_r.at[b].set(jnp.where(upd, mr, s.bmin_r[b]))
+        bdel_t = s.bdel_t.at[b].set(jnp.where(upd, mdel, s.bdel_t[b]))
+
+        # ---- evaluator bookkeeping (identical to the exact engine)
+        valid = active & ~alloc_fail
+        events = s.events_processed + valid.astype(jnp.int32)
+        fire = valid & (s.snap_idx < klen) & (
+            events >= ktable[jnp.minimum(s.snap_idx, klen - 1)])
+        used = jnp.stack([
+            (total_cpu - jnp.sum(cpu_left)).astype(f),
+            (total_mem - jnp.sum(mem_left)).astype(f),
+            jnp.sum(c.num_gpus - gpu_left).astype(f),
+            (total_gm - jnp.sum(gpu_milli_left)).astype(f),
+        ])
+        totals_vec = jnp.stack([total_cpu, total_mem, total_gc, total_gm])
+        denom = jnp.maximum(totals_vec, 1).astype(f)
+        utils = jnp.where(totals_vec <= 0, 0, used / denom)
+        snap_sums = s.snap_sums + jnp.where(fire, utils, 0)
+        snap_idx = s.snap_idx + fire.astype(jnp.int32)
+
+        active_nodes = jnp.sum((c.node_mask & (
+            (cpu_left < c.cpu_total) | (mem_left < c.mem_total)
+            | (gpu_left < c.num_gpus))), dtype=jnp.int32)
+        max_nodes = jnp.maximum(s.max_nodes, jnp.where(valid, active_nodes, 0))
+
+        violations = s.violations
+        if cfg.validate_invariants:
+            # slice off the queue's block padding: the audit segment-sums
+            # against [pp]-shaped per-pod request arrays
+            active_pods = (ev_kind[:pp] == K_DELETE) & (ev_time[:pp] < INF)
+            violations = violations + active.astype(jnp.int32) * _audit(
+                c, p, active_pods, cpu_left, mem_left, gpu_left,
+                gpu_milli_left, assigned_node, assigned_gpus)
+
+        return FlatState(
+            ev_time=ev_time, ev_kind=ev_kind,
+            bmin_t=bmin_t, bmin_r=bmin_r, bdel_t=bdel_t,
+            cpu_left=cpu_left, mem_left=mem_left, gpu_left=gpu_left,
+            gpu_milli_left=gpu_milli_left, assigned_node=assigned_node,
+            assigned_gpus=assigned_gpus, pod_ctime=pod_ctime,
+            wait_hist=hist, events_processed=events, snap_idx=snap_idx,
+            snap_sums=snap_sums, frag_sum=frag_sum, frag_count=frag_count,
+            max_nodes=max_nodes, failed=s.failed | alloc_fail,
+            steps=s.steps + active.astype(jnp.int32), violations=violations,
+        )
+
+    return step
+
+
+def finalize(workload: Workload, cfg: SimConfig, s: FlatState) -> SimResult:
+    return finalize_fields(
+        workload, cfg, pending=jnp.min(s.bmin_t) < INF, s=s)
+
+
+def make_param_run_fn(workload: Workload, param_policy,
+                      cfg: SimConfig = SimConfig()):
+    """``run(params, state) -> SimResult`` — flat-engine counterpart of
+    engine.make_param_run_fn (same ktable/max_steps/finalize assembly)."""
+    ktable, max_steps = loop_tables(workload, cfg)
+
+    def cond(s: FlatState):
+        return lane_active(s, max_steps)
+
+    def run(params, state: FlatState) -> SimResult:
+        step = build_step(
+            workload, lambda pod, nodes: param_policy(params, pod, nodes),
+            cfg, ktable, max_steps)
+        final = jax.lax.while_loop(cond, step, state)
+        return finalize(workload, cfg, final)
+
+    return run
+
+
+def make_run_fn(workload: Workload, policy: PolicyFn,
+                cfg: SimConfig = SimConfig()):
+    run = make_param_run_fn(
+        workload, lambda _p, pod, nodes: policy(pod, nodes), cfg)
+    return functools.partial(run, None)
+
+
+def simulate(workload: Workload, policy: PolicyFn,
+             cfg: SimConfig = SimConfig(), jit: bool = True) -> SimResult:
+    """Host convenience API, mirroring engine.simulate."""
+    run = make_run_fn(workload, policy, cfg)
+    if jit:
+        run = jax.jit(run)
+    return run(initial_state(workload, cfg))
+
+
+def broadcast_state(state0: FlatState, lanes: int) -> FlatState:
+    return jax.tree_util.tree_map(
+        lambda x: jnp.broadcast_to(jnp.asarray(x), (lanes,) + jnp.shape(x)),
+        state0)
+
+
+def make_population_run_fn(workload: Workload, param_policy,
+                           cfg: SimConfig = SimConfig()):
+    """``run(params[C, ...], state0) -> SimResult`` batched over candidates:
+    ONE while_loop whose body is the vmapped self-masking step (finished
+    lanes idle cheaply), exactly like engine.make_population_run_fn."""
+    ktable, max_steps = loop_tables(workload, cfg)
+
+    def run(params, state0: FlatState) -> SimResult:
+        pop = jax.tree_util.tree_leaves(params)[0].shape[0]
+
+        def step_one(prm, s):
+            return build_step(
+                workload, lambda pod, nodes: param_policy(prm, pod, nodes),
+                cfg, ktable, max_steps)(s)
+
+        vstep = jax.vmap(step_one, in_axes=(0, 0))
+        final = jax.lax.while_loop(
+            lambda s: jnp.any(lane_active(s, max_steps)),
+            lambda s: vstep(params, s), broadcast_state(state0, pop))
+        return jax.vmap(lambda s: finalize(workload, cfg, s))(final)
+
+    return run

@@ -79,6 +79,38 @@ class SimState(NamedTuple):
     violations: Any  # i32: invariant-audit failures (0 unless enabled)
 
 
+class FlatState(NamedTuple):
+    """The flat engine's while_loop carry (fks_tpu.sim.flat): slot-per-pod
+    event queue + per-block min hierarchy + the SAME cluster/evaluator
+    fields as SimState (finalize_fields consumes either)."""
+
+    # event queue: one slot per pod + two-level min index
+    ev_time: Any  # i32[P]; INF = no pending event
+    ev_kind: Any  # i32[P]; 0=CREATE 1=DELETE 2=RETRY-CREATE
+    bmin_t: Any  # i32[B] per-block min event time
+    bmin_r: Any  # i32[B] tie rank at that min
+    bdel_t: Any  # i32[B] per-block min pending-DELETE time, INF if none
+    # cluster + pod scheduling state (as SimState)
+    cpu_left: Any
+    mem_left: Any
+    gpu_left: Any
+    gpu_milli_left: Any
+    assigned_node: Any
+    assigned_gpus: Any
+    pod_ctime: Any
+    wait_hist: Any
+    # evaluator accumulators (as SimState)
+    events_processed: Any
+    snap_idx: Any
+    snap_sums: Any
+    frag_sum: Any
+    frag_count: Any
+    max_nodes: Any
+    failed: Any
+    steps: Any
+    violations: Any
+
+
 class SimResult(NamedTuple):
     """Final observables; superset of reference EvaluationResults
     (evaluator.py:16-25) + policy score + run metadata."""

@@ -1,0 +1,154 @@
+"""Primitive cost model for the per-event step on the current device.
+
+Measures the building blocks a fast event engine could be made of, each as
+a jitted ``lax.while_loop`` over ``--steps`` iterations at several lane
+(population) widths:
+
+  dense16     predicated dense update of a [L,16] i32 row (no scatter)
+  dense-grid  predicated dense update of a [L,16,8] grid
+  scat1-8k    batched 1-element scatter into [L,8192]
+  scat15-8k   batched 15-element scatter into [L,8192] (heap-sift shape)
+  scat15u-8k  same with unique_indices=True
+  gath15-8k   batched 15-element gather from [L,8192]
+  chain14     14 DEPENDENT rounds of 2-wide dynamic-slice gathers
+              (the heap pop descent's critical path shape)
+  argmin256   masked argmin over a [L,256] ring buffer
+  tape-read   indexed row read from a static [40k, 8] tape
+  dense-8k    full dense blend of [L,8192] (scatter-free waiting-set upd)
+
+Output feeds PROFILE.md; design decisions reference these numbers.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def timed(fn, *args):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    out = fn(*args)
+    jax.block_until_ready(out)
+    return time.perf_counter() - t0
+
+
+def loop(body, carry0, steps):
+    def cond(c):
+        return c[0] < steps
+
+    def wrapped(c):
+        i, x = c
+        return (i + 1, body(i, x))
+
+    return jax.lax.while_loop(cond, wrapped, (jnp.int32(0), carry0))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=2048)
+    ap.add_argument("--lanes", type=str, default="16,256,1024")
+    args = ap.parse_args()
+    steps = args.steps
+
+    dev = jax.devices()[0]
+    print(f"device: {dev.platform} ({dev.device_kind}); steps={steps}",
+          file=sys.stderr)
+
+    P = 8192
+    tape = jnp.arange(40000 * 8, dtype=jnp.int32).reshape(40000, 8)
+
+    for lanes in [int(x) for x in args.lanes.split(",")]:
+        key = jax.random.PRNGKey(0)
+        row = jnp.zeros((lanes, 16), jnp.int32)
+        grid = jnp.zeros((lanes, 16, 8), jnp.int32)
+        big = jnp.zeros((lanes, P), jnp.int32)
+        ring = jnp.zeros((lanes, 256), jnp.int32)
+        idx1 = jax.random.randint(key, (lanes,), 0, P)
+        idx15 = jax.random.randint(key, (lanes, 15), 0, P)
+
+        def mk(name):
+            if name == "dense16":
+                def body(i, c):
+                    b = (i + jnp.arange(lanes)) % 16
+                    return c + jnp.where(jnp.arange(16)[None, :] == b[:, None], i, 0)
+                return body, row
+            if name == "dense-grid":
+                def body(i, c):
+                    b = (i + jnp.arange(lanes)) % 16
+                    oh = (jnp.arange(16)[None, :] == b[:, None])
+                    return c + jnp.where(oh[:, :, None], i, 0)
+                return body, grid
+            if name == "scat1-8k":
+                def body(i, c):
+                    ix = (idx1 + i) % P
+                    return jax.vmap(lambda a, j, v: a.at[j].set(v))(
+                        c, ix, i + jnp.arange(lanes))
+                return body, big
+            if name in ("scat15-8k", "scat15u-8k"):
+                uniq = name.endswith("u-8k")
+                def body(i, c):
+                    ix = (idx15 + i) % P
+                    vals = jnp.broadcast_to(i, (lanes, 15)) + ix
+                    return jax.vmap(lambda a, j, v: a.at[j].set(
+                        v, mode="drop", unique_indices=uniq))(c, ix, vals)
+                return body, big
+            if name == "gath15-8k":
+                def body(i, c):
+                    ix = (idx15 + i) % P
+                    g = jax.vmap(lambda a, j: a[j])(c, ix)
+                    return c + jnp.sum(g, axis=1, keepdims=True) * 0 + 1
+                return body, big
+            if name == "chain14":
+                def body(i, c):
+                    pos = jnp.zeros((lanes,), jnp.int32) + (i % 7)
+                    acc = jnp.zeros((lanes,), jnp.int32)
+                    for _ in range(14):
+                        pair = jax.vmap(
+                            lambda a, p: jax.lax.dynamic_slice_in_dim(a, p, 2))(
+                                c, jnp.clip(2 * pos + 1, 0, P - 2))
+                        use_r = pair[:, 1] < pair[:, 0]
+                        pos = jnp.clip(2 * pos + 1 + use_r.astype(jnp.int32),
+                                       0, P - 1)
+                        acc = acc + pair[:, 0]
+                    return c.at[:, 0].set(acc)
+                return body, big
+            if name == "argmin256":
+                def body(i, c):
+                    m = jnp.argmin(c + i % 3, axis=1)
+                    return jax.vmap(lambda a, j, v: a.at[j].set(v))(
+                        c, m, i + jnp.arange(lanes))
+                return body, ring
+            if name == "tape-read":
+                def body(i, c):
+                    r = tape[jnp.minimum(i, 39999)]
+                    return c.at[:, :8].add(r[None, :])
+                return body, row if False else jnp.zeros((lanes, 16), jnp.int32)
+            if name == "dense-8k":
+                def body(i, c):
+                    ix = (idx1 + i) % P
+                    oh = jnp.arange(P)[None, :] == ix[:, None]
+                    return jnp.where(oh, c + i, c)
+                return body, big
+            raise ValueError(name)
+
+        for name in ["dense16", "dense-grid", "scat1-8k", "scat15-8k",
+                     "scat15u-8k", "gath15-8k", "chain14", "argmin256",
+                     "tape-read", "dense-8k"]:
+            body, c0 = mk(name)
+            fn = jax.jit(lambda c, b=body: loop(b, c, steps))
+            secs = timed(fn, c0)
+            print(f"lanes={lanes:5d} {name:11s} {secs / steps * 1e6:9.2f} us/step",
+                  flush=True)
+
+
+if __name__ == "__main__":
+    main()
