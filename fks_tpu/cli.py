@@ -92,6 +92,11 @@ def _print_table(rows):
         print("  ".join(str(r[c]).rjust(widths[c]) for c in cols))
 
 
+def _pick_simulate(args):
+    from fks_tpu.sim import get_engine
+    return get_engine(getattr(args, "engine", "exact")).simulate
+
+
 def cmd_bench(args):
     """The reference benchmark table (test_scheduler.py:287-331): every
     requested policy against the workload, jit-compiled, with wall time."""
@@ -99,10 +104,10 @@ def cmd_bench(args):
     import jax.numpy as jnp
 
     from fks_tpu.models import zoo
-    from fks_tpu.sim.engine import SimConfig, simulate
-
+    from fks_tpu.sim.engine import SimConfig
     from fks_tpu.utils import result_record
 
+    simulate = _pick_simulate(args)
     _, wl = _parse_workload(args)
     names = (args.policies.split(",") if args.policies else list(zoo.ZOO))
     dtype = jnp.float64 if args.f64 else jnp.float32
@@ -139,10 +144,10 @@ def cmd_simulate(args):
     import numpy as np
 
     from fks_tpu.models import zoo
-    from fks_tpu.sim.engine import SimConfig, simulate
-
+    from fks_tpu.sim.engine import SimConfig
     from fks_tpu.utils import result_record
 
+    simulate = _pick_simulate(args)
     _, wl = _parse_workload(args)
     dtype = jnp.float64 if args.f64 else jnp.float32
     cfg = SimConfig(score_dtype=dtype, validate_invariants=args.validate)
@@ -195,12 +200,15 @@ def cmd_evolve(args):
                 # leaves a complete metric trail up to the crash point
                 metrics.write("generation", dataclasses.asdict(st))
         fs = evo.run(wl, cfg, backend=backend, sim_config=SimConfig(),
-                     checkpoint_path=args.checkpoint, on_generation=on_gen)
+                     checkpoint_path=args.checkpoint, out_dir=args.out,
+                     engine=args.engine, on_generation=on_gen)
     if fs.best:
         print(f"best fitness: {fs.best[1]:.4f}")
-        if args.out:
+        # on interrupt evo.run already persisted champions — don't double-save
+        if args.out and not getattr(fs, "interrupted", False):
             path = fs.save_top_policies(args.out, k=5)
             print(f"saved top policies to {path}")
+            print(f"saved best policy to {fs.save_best_policy(args.out)}")
     return 0
 
 
@@ -234,12 +242,13 @@ def cmd_scale(args):
             mesh = population_mesh(devices)
             padded, real = pad_population(pop, mesh)
             ev = make_sharded_eval(wl, mesh, cfg=cfg,
-                                   elite_k=min(4, args.pop))
+                                   elite_k=min(4, args.pop),
+                                   engine=args.engine)
             with timed("eval") as t:
                 scores = t.sync(ev(padded, real)[0])[:real]
             mode = f"sharded over {len(devices)} devices"
         else:
-            evp = make_population_eval(wl, cfg=cfg)
+            evp = make_population_eval(wl, cfg=cfg, engine=args.engine)
             with timed("eval") as t:
                 res = t.sync(evp(pop))
             scores = res.policy_score
@@ -281,6 +290,11 @@ def main(argv=None) -> int:
                         help="force the CPU backend (skip the TPU tunnel)")
     common.add_argument("--metrics", default="",
                         help="append JSONL metric records to this file")
+    common.add_argument("--engine", choices=("exact", "flat"), default="exact",
+                        help="simulation engine: 'exact' replicates the "
+                             "reference bit-for-bit; 'flat' is the TPU "
+                             "throughput engine (documented retry-rule "
+                             "divergence, fks_tpu.sim.flat)")
 
     b = sub.add_parser("bench", help="policy comparison table", parents=[common])
     _add_trace_flags(b)

@@ -4,6 +4,9 @@ TPU-native counterpart of the reference ``funsearch/`` package
 (reference: funsearch/safe_execution.py + funsearch/funsearch_integration.py).
 """
 from fks_tpu.funsearch.backend import CodeEvaluator, EvalRecord
+from fks_tpu.funsearch.device_evolution import (
+    DeviceGenStats, ParametricEvolution,
+)
 from fks_tpu.funsearch.evolution import (
     EvolutionConfig, FunSearch, GenerationStats, LLMSettings, run,
 )
@@ -17,8 +20,10 @@ from fks_tpu.funsearch.template import build_prompt, fill_template, seed_policie
 from fks_tpu.funsearch.transpiler import TranspileError, canonical_key, transpile
 
 __all__ = [
-    "CandidateGenerator", "CodeEvaluator", "EvalRecord", "EvolutionConfig",
+    "CandidateGenerator", "CodeEvaluator", "DeviceGenStats", "EvalRecord",
+    "EvolutionConfig",
     "FakeLLM", "FunSearch", "GenerationStats", "LLMSettings", "OpenAIBackend",
+    "ParametricEvolution",
     "ScalarGPU", "ScalarNode", "ScalarPod", "TranspileError", "build_prompt",
     "canonical_key", "execute_scalar", "fill_template", "generate_many",
     "run", "seed_policies", "smoke_test", "transpile", "validate",

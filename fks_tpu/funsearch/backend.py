@@ -41,9 +41,7 @@ import dataclasses as _dc
 
 from fks_tpu.data.entities import Workload
 from fks_tpu.funsearch import transpiler, vm
-from fks_tpu.sim.engine import (
-    SimConfig, initial_state, make_param_run_fn, make_run_fn,
-)
+from fks_tpu.sim.engine import SimConfig
 from fks_tpu.sim.types import SimResult
 
 
@@ -73,10 +71,15 @@ class CodeEvaluator:
     VM_CAPACITY = 512  # op budget; longer programs use the jit tier
 
     def __init__(self, workload: Workload, cfg: SimConfig = SimConfig(),
-                 max_workers: Optional[int] = None, use_vm: bool = True):
+                 max_workers: Optional[int] = None, use_vm: bool = True,
+                 engine: str = "exact"):
+        from fks_tpu.sim import get_engine
+
         self.workload = workload
         self.cfg = cfg
-        self.state0 = initial_state(workload, cfg)
+        self.engine = engine
+        self._mod = get_engine(engine)
+        self.state0 = self._mod.initial_state(workload, cfg)
         self._cache: Dict[str, object] = {}
         self._lock = threading.Lock()
         self.compile_count = 0  # observability: unique programs built
@@ -94,7 +97,7 @@ class CodeEvaluator:
             # lax.cond executes one branch
             cfg = _dc.replace(self.cfg, cond_policy=True)
             self._vm_run = jax.jit(
-                make_param_run_fn(self.workload, vm.score, cfg))
+                self._mod.make_param_run_fn(self.workload, vm.score, cfg))
         return self._vm_run
 
     def _try_vm(self, code: str) -> Optional[SimResult]:
@@ -119,7 +122,7 @@ class CodeEvaluator:
             # code (GIL released), so distinct candidates compile in
             # parallel across evaluate()'s thread pool
             policy = transpiler.transpile(code)
-            fn = jax.jit(make_run_fn(self.workload, policy, self.cfg))
+            fn = jax.jit(self._mod.make_run_fn(self.workload, policy, self.cfg))
             with self._lock:
                 if key in self._cache:  # lost the race: reuse the winner
                     fn = self._cache[key]

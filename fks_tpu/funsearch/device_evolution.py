@@ -1,0 +1,97 @@
+"""Device-resident parametric evolution: weights never leave the mesh.
+
+The reference's evolution loop moves every candidate through the host on
+every generation (ProcessPool pickling, reference: funsearch/
+funsearch_integration.py:535-562). The parametric tier has no reason to:
+the population weight matrix lives sharded over the mesh, and each
+generation is ONE compiled program — sharded evaluation, ICI all-gather of
+fitness, global top-k elite selection, mutation (fks_tpu.parallel.mesh.
+make_sharded_generation_step). Only per-generation scores (a few floats)
+cross to the host, for logging.
+
+Two uses:
+- standalone: ``ParametricEvolution.run(generations)`` — pure weight-space
+  search at device speed;
+- inside FunSearch (fks_tpu.funsearch.evolution): between LLM rounds, a
+  persistent ParametricEvolution advances ``parametric_rounds`` device
+  generations, then its best weight vector is RENDERED to candidate source
+  (models.parametric.render_code) and fed through the normal sandbox ->
+  transpile -> evaluate -> dedup admission path, cross-pollinating the
+  code population — the integration backend.py's tier list promises.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, List, Optional
+
+import jax
+import numpy as np
+
+from fks_tpu.models import parametric
+from fks_tpu.parallel import (
+    make_sharded_generation_step, pad_population, population_mesh,
+)
+from fks_tpu.sim.engine import SimConfig
+
+
+@dataclasses.dataclass
+class DeviceGenStats:
+    generation: int
+    best_score: float
+    mean_score: float
+
+
+class ParametricEvolution:
+    """Persistent device-resident weight-space evolution over a mesh."""
+
+    def __init__(self, workload, mesh=None, pop_size: int = 64,
+                 elite_k: int = 4, noise: float = 0.05,
+                 cfg: SimConfig = SimConfig(), engine: str = "exact",
+                 seed: int = 0, init_noise: float = 0.1):
+        self.mesh = mesh if mesh is not None else population_mesh()
+        self.step = make_sharded_generation_step(
+            workload, self.mesh, cfg=cfg, elite_k=elite_k, noise=noise,
+            engine=engine)
+        key = jax.random.PRNGKey(seed)
+        self._key, sub = jax.random.split(key)
+        params, self.real_count = pad_population(
+            parametric.init_population(sub, pop_size, noise=init_noise),
+            self.mesh)
+        self.params = params  # device-resident across generations
+        self.generation = 0
+        self.history: List[DeviceGenStats] = []
+        self.best_score = float("-inf")
+        self._best_params = None
+
+    def run(self, generations: int,
+            on_generation: Optional[Callable[[DeviceGenStats], None]] = None,
+            ) -> DeviceGenStats:
+        """Advance ``generations`` device steps; params stay on device."""
+        last = None
+        for _ in range(generations):
+            self._key, sub = jax.random.split(self._key)
+            self.params, scores, elite_scores = self.step(
+                self.params, sub, self.real_count)
+            self.generation += 1
+            # elites survive in the leading slots (mesh.gen_step layout),
+            # so row 0 of the NEW population is the best of this round
+            best = float(np.asarray(elite_scores)[0])
+            if best > self.best_score:
+                self.best_score = best
+                self._best_params = self.params[0]
+            real = np.asarray(scores)[: self.real_count]
+            last = DeviceGenStats(self.generation, best, float(real.mean()))
+            self.history.append(last)
+            if on_generation is not None:
+                on_generation(last)
+        return last
+
+    @property
+    def best_params(self):
+        if self._best_params is None:
+            raise ValueError("run() has not advanced any generation yet")
+        return self._best_params
+
+    def best_code(self) -> str:
+        """The champion weights rendered as reference-style source."""
+        return parametric.render_code(np.asarray(self.best_params))

@@ -59,6 +59,13 @@ class EvolutionConfig:
     similarity_threshold: float = 0.85
     candidates_per_generation: int = 8  # reference cap: min(8, pop - elite)
     seed: int = 0
+    # device-resident parametric rounds interleaved between LLM rounds
+    # (0 = off): each generation additionally advances this many compiled
+    # weight-evolution steps on the mesh and admits the rendered champion
+    # through the normal code path (fks_tpu.funsearch.device_evolution)
+    parametric_rounds: int = 0
+    parametric_pop: int = 32
+    parametric_noise: float = 0.05
 
     llm: LLMSettings = dataclasses.field(default_factory=LLMSettings)
 
@@ -77,6 +84,9 @@ class EvolutionConfig:
             elite_size=fs.get("elite_size", 5),
             max_workers=fs.get("max_workers", 8),
             similarity_threshold=fs.get("similarity_threshold", 0.85),
+            parametric_rounds=fs.get("parametric_rounds", 0),
+            parametric_pop=fs.get("parametric_pop", 32),
+            parametric_noise=fs.get("parametric_noise", 0.05),
             llm=LLMSettings(
                 api_key=lm.get("api_key", ""),
                 base_url=lm.get("base_url", LLMSettings.base_url),
@@ -131,6 +141,11 @@ class FunSearch:
         self.generation = 0
         self.best: Optional[Member] = None
         self.history: List[GenerationStats] = []
+        # lazily built device-resident parametric searcher; its weight
+        # population persists on device across generations (its state is
+        # NOT checkpointed — rendered champions persist via the code
+        # population instead)
+        self._device_evo = None
 
     # ----- population mechanics (reference funsearch_integration.py:174-215)
 
@@ -208,6 +223,15 @@ class FunSearch:
                 continue
             self._admit(r.code, r.score)
             accepted += 1
+
+        if cfg.parametric_rounds > 0:
+            r = self._parametric_round()
+            if r is not None:
+                if self._is_too_similar(r.code, r.score):
+                    rejected += 1
+                else:
+                    self._admit(r.code, r.score)
+                    accepted += 1
         self._sort()
         del self.population[cfg.population_size:]
 
@@ -230,6 +254,25 @@ class FunSearch:
             f"accepted {stats.accepted} (dup-rejected {stats.rejected_similar}) "
             f"eval {eval_s:.2f}s programs {stats.compile_count}")
         return stats
+
+    def _parametric_round(self):
+        """Advance the device-resident weight search and feed its champion
+        back into the code population through the normal evaluation path
+        (the rendered source is re-scored by the evaluator, so the
+        admission comparison is apples-to-apples with LLM candidates)."""
+        from fks_tpu.funsearch.device_evolution import ParametricEvolution
+
+        if self._device_evo is None:
+            self._device_evo = ParametricEvolution(
+                self.evaluator.workload, pop_size=self.cfg.parametric_pop,
+                noise=self.cfg.parametric_noise, cfg=self.evaluator.cfg,
+                engine=self.evaluator.engine, seed=self.cfg.seed)
+        st = self._device_evo.run(self.cfg.parametric_rounds)
+        self.log(f"  parametric: gen {st.generation} best {st.best_score:.4f} "
+                 f"mean {st.mean_score:.4f} (device-resident)")
+        code = self._device_evo.best_code()
+        rec = self.evaluator.evaluate([code])[0]
+        return rec
 
     def run_evolution(self) -> Tuple[str, float]:
         """Full loop -> (best_code, best_score) (reference:
@@ -264,6 +307,23 @@ class FunSearch:
             json.dump(payload, f, indent=2)
         return path
 
+    def save_best_policy(self, directory: str = "policies/discovered") -> str:
+        """Single-champion JSON, reference schema {score, generation, code,
+        timestamp} and filename pattern ``funsearch_<stamp>_score<s>.json``
+        (reference: funsearch_integration.py:606-633)."""
+        if self.best is None:
+            raise ValueError("no best policy to save")
+        code, score = self.best
+        os.makedirs(directory, exist_ok=True)
+        stamp = time.strftime("%Y%m%d_%H%M%S")
+        path = os.path.join(directory, f"funsearch_{stamp}_score{score:.4f}.json")
+        with open(path, "w") as f:
+            json.dump({"score": score, "generation": self.generation,
+                       "code": code,
+                       "timestamp": time.strftime("%Y-%m-%dT%H:%M:%S")},
+                      f, indent=2)
+        return path
+
     def checkpoint(self, path: str) -> None:
         """Mid-evolution state: population, best, generation, RNG — enough
         to resume bit-identically (absent from the reference; SURVEY.md §5
@@ -280,6 +340,9 @@ class FunSearch:
         backend = self.generator.backend
         if hasattr(backend, "getstate"):
             state["backend_state"] = backend.getstate()
+        parent = os.path.dirname(path)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
         tmp = path + ".tmp"
         with open(tmp, "w") as f:
             json.dump(state, f)
@@ -317,18 +380,37 @@ def run(workload, config: Optional[EvolutionConfig] = None,
         backend: Optional[llm_mod.TextBackend] = None,
         sim_config: SimConfig = SimConfig(),
         checkpoint_path: Optional[str] = None,
+        out_dir: Optional[str] = None,
+        engine: str = "exact",
         log: Callable[[str], None] = print,
         on_generation: Optional[Callable[[GenerationStats], None]] = None,
         ) -> FunSearch:
     """Assemble evaluator + driver, optionally resuming from a checkpoint,
-    and run to completion. Returns the driver for inspection."""
-    fs = FunSearch(CodeEvaluator(workload, sim_config),
+    and run to completion. Returns the driver for inspection.
+
+    A KeyboardInterrupt mid-evolution still persists champions (top-K +
+    single best into ``out_dir``, reference: funsearch_integration.py:
+    698-702) and the checkpoint — a long device run killed at the terminal
+    must never lose its discoveries."""
+    fs = FunSearch(CodeEvaluator(workload, sim_config, engine=engine),
                    config or EvolutionConfig(), backend, log,
                    on_generation=on_generation)
     if checkpoint_path and os.path.exists(checkpoint_path):
         fs.restore(checkpoint_path)
         log(f"resumed from {checkpoint_path} at generation {fs.generation}")
-    fs.run_evolution()
+    fs.interrupted = False  # callers: champions already persisted when True
+    try:
+        fs.run_evolution()
+    except KeyboardInterrupt:
+        fs.interrupted = True
+        log("evolution interrupted; saving champions")
+        if fs.population and out_dir:
+            log(f"top policies saved to {fs.save_top_policies(out_dir, k=5)}")
+        if fs.best and out_dir:
+            log(f"best policy saved to {fs.save_best_policy(out_dir)}")
+        if checkpoint_path:
+            fs.checkpoint(checkpoint_path)
+        return fs
     if checkpoint_path:
         fs.checkpoint(checkpoint_path)
     return fs

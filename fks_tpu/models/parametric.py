@@ -157,3 +157,74 @@ def mutate(key, parents, pop_size: int, noise: float = 0.05):
     idx = jax.random.randint(k1, (pop_size,), 0, parents.shape[0])
     base = parents[idx]
     return base + noise * jax.random.normal(k2, base.shape, base.dtype)
+
+
+# ------------------------------------------------- weights -> candidate code
+
+#: Restricted-Python rendering of each feature, in FEATURE_NAMES order.
+#: The expressions use only the transpilable subset (and the reference's
+#: whitelisted builtins, safe_execution.py:19-27), so a rendered candidate
+#: flows through the normal code path: sandbox -> transpiler -> engine.
+_FEATURE_EXPRS = (
+    "1.0",
+    "(node.cpu_milli_left - pod.cpu_milli) / max(1, node.cpu_milli_total)",
+    "(node.memory_mib_left - pod.memory_mib) / max(1, node.memory_mib_total)",
+    "(node.gpu_left - pod.num_gpu) / max(1, len(node.gpus))",
+    "1.0 - node.cpu_milli_left / max(1, node.cpu_milli_total)",
+    "1.0 - node.memory_mib_left / max(1, node.memory_mib_total)",
+    "1.0 - node.gpu_left / max(1, len(node.gpus))",
+    "1.0 - free_milli / max(1, total_milli)",
+    "1.0 - abs(node.cpu_milli_left / max(1, node.cpu_milli_total)"
+    " - node.memory_mib_left / max(1, node.memory_mib_total))",
+    "((free_milli % max(1, pod.gpu_milli)) / 1000.0) if pod.num_gpu > 0 else 0.0",
+    "sum(1 for gpu in node.gpus if gpu.gpu_milli_left >= pod.gpu_milli)"
+    " / max(1, len(node.gpus))",
+    "1.0 if pod.num_gpu > 0 else 0.0",
+    "1.0 if len(node.gpus) > 0 else 0.0",
+    "1.0 - (0.33 * (node.cpu_milli_left - pod.cpu_milli) / max(1, node.cpu_milli_total)"
+    " + 0.33 * (node.memory_mib_left - pod.memory_mib) / max(1, node.memory_mib_total)"
+    " + 0.34 * (node.gpu_left - pod.num_gpu) / max(1, len(node.gpus)))",
+    "((max(gpu.gpu_milli_left for gpu in node.gpus)"
+    " - min(gpu.gpu_milli_left for gpu in node.gpus)) / 1000.0)"
+    " if len(node.gpus) > 0 else 0.0",
+    "1.0 if (node.cpu_milli_left > 2 * pod.cpu_milli"
+    " and node.memory_mib_left > 2 * pod.memory_mib) else 0.0",
+)
+
+#: features whose expression reads the free/total gpu_milli prologue vars
+_NEEDS_MILLI = {"gpu_milli_util", "frag_mod"}
+
+
+def render_code(params, threshold: float = 1e-4) -> str:
+    """Render a weight vector as a reference-style candidate SOURCE — the
+    bridge from the device-resident parametric search back into the code
+    population: the rendered candidate re-enters through the normal
+    sandbox/transpiler/dedup pipeline and is re-scored there, so rendering
+    need not be bit-exact to the f32 on-device arithmetic (and is not).
+
+    Near-zero weights are dropped to keep candidates short and readable.
+    """
+    import numpy as np
+
+    from fks_tpu.funsearch import template
+
+    w = np.asarray(params, np.float64)
+    terms = []
+    needs_milli = False
+    for name, expr, wi in zip(FEATURE_NAMES, _FEATURE_EXPRS, w):
+        if abs(wi) < threshold:
+            continue
+        terms.append(f"({wi:.6g}) * ({expr})")
+        if name in _NEEDS_MILLI:
+            needs_milli = True
+    if not terms:
+        terms = ["0.0"]
+    lines = []
+    if needs_milli:
+        lines.append("free_milli = sum(gpu.gpu_milli_left for gpu in node.gpus)")
+        lines.append(
+            "total_milli = sum(gpu.gpu_milli_total for gpu in node.gpus)")
+    body = "\n    + ".join(terms)
+    lines.append(f"score = {SCORE_SCALE:.1f} * ({body})")
+    return template.fill_template("\n".join("    " + l if i else l
+                                            for i, l in enumerate(lines)))

@@ -1,0 +1,79 @@
+"""Device-resident parametric evolution (fks_tpu.funsearch.device_evolution)
+and the weights->code bridge (models.parametric.render_code).
+
+Runs on the 8-virtual-device CPU mesh (conftest), i.e. the sharded
+generation step is exercised with real population sharding + all-gather.
+"""
+import jax
+import numpy as np
+import pytest
+
+from fks_tpu.funsearch import (
+    CodeEvaluator, EvolutionConfig, FakeLLM, FunSearch, ParametricEvolution,
+)
+from fks_tpu.models import parametric, zoo
+from fks_tpu.sim.engine import SimConfig, simulate
+from tests.test_engine_micro import micro_workload
+
+
+def quiet(*_a, **_k):
+    pass
+
+
+def test_n_generations_through_sharded_step():
+    """VERDICT #6 'done' criterion: N generations through the sharded
+    generation step with weights staying device-resident."""
+    wl = micro_workload()
+    evo = ParametricEvolution(wl, pop_size=16, elite_k=4, seed=1)
+    st = evo.run(3)
+    assert evo.generation == 3
+    assert len(evo.history) == 3
+    assert st.best_score >= 0.0
+    # best never decreases across rounds (elites survive)
+    bests = [h.best_score for h in evo.history]
+    assert bests == sorted(bests)
+    # params stayed sharded on the mesh across rounds
+    assert evo.params.shape[1] == parametric.NUM_FEATURES
+    assert len(evo.params.sharding.device_set) == len(jax.devices())
+
+
+def test_rendered_champion_is_valid_candidate():
+    wl = micro_workload()
+    evo = ParametricEvolution(wl, pop_size=8, elite_k=2, seed=2)
+    evo.run(1)
+    code = evo.best_code()
+    rec = CodeEvaluator(wl).evaluate([code])[0]
+    assert rec.ok, rec.error
+
+
+@pytest.mark.parametrize("seed_name", ["best_fit", "packing"])
+def test_render_code_fitness_close_to_parametric(seed_name, default_workload):
+    """The rendered source re-scored through the code path lands near the
+    on-device parametric fitness (rendering is f64 Python vs f32 device
+    arithmetic, so near, not equal)."""
+    w = parametric.seed_weights(seed_name)
+    dev = simulate(default_workload, parametric.as_policy(w))
+    from fks_tpu.funsearch import transpiler
+    rendered = simulate(default_workload,
+                        transpiler.transpile(parametric.render_code(w)))
+    assert abs(float(dev.policy_score) - float(rendered.policy_score)) < 2e-2
+    assert int(rendered.scheduled_pods) == int(dev.scheduled_pods)
+
+
+def test_funsearch_hybrid_parametric_rounds():
+    """FunSearch with parametric_rounds > 0 interleaves device rounds and
+    admits the rendered champion through the normal dedup/admission path."""
+    wl = micro_workload()
+    cfg = EvolutionConfig(population_size=8, generations=2, elite_size=2,
+                          candidates_per_generation=2, max_workers=1, seed=3,
+                          early_stop_threshold=1.1, parametric_rounds=2,
+                          parametric_pop=8)
+    fs = FunSearch(CodeEvaluator(wl), cfg, backend=FakeLLM(seed=3), log=quiet)
+    fs.run_evolution()
+    assert fs.best is not None
+    assert fs._device_evo is not None
+    assert fs._device_evo.generation == 4  # 2 rounds x 2 generations
+    # the device searcher's champion entered the code population in
+    # rendered form at least once (or was dedup-rejected against a better
+    # incumbent — either way the loop must have evaluated it)
+    assert fs.history[-1].generation == 2

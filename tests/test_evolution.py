@@ -125,6 +125,49 @@ def test_save_top_policies_schema(evaluator, tmp_path):
     assert payload[0]["score"] >= payload[1]["score"]
 
 
+def test_save_best_policy_schema(evaluator, tmp_path):
+    """Single-champion JSON: reference filename pattern + {score,
+    generation, code, timestamp} schema (funsearch_integration.py:606-633)."""
+    fs = make_fs(evaluator)
+    fs.initialize_population()
+    path = fs.save_best_policy(str(tmp_path / "discovered"))
+    assert "funsearch_" in path and "_score" in path
+    with open(path) as f:
+        payload = json.load(f)
+    assert set(payload) == {"score", "generation", "code", "timestamp"}
+    assert payload["score"] == fs.best[1]
+    assert payload["code"] == fs.best[0]
+
+
+def test_interrupt_mid_evolution_saves_champions(tmp_path, monkeypatch):
+    """A KeyboardInterrupt inside the generation loop still leaves top-K +
+    best champion JSONs and a checkpoint on disk (reference saves top-5 on
+    interrupt, funsearch_integration.py:698-702)."""
+    out = tmp_path / "discovered"
+    ck = str(tmp_path / "evo.json")
+    cfg = EvolutionConfig(population_size=6, generations=3, elite_size=2,
+                          candidates_per_generation=2, max_workers=1, seed=3,
+                          early_stop_threshold=1.1)
+    calls = {"n": 0}
+    orig = FunSearch.evolve_generation
+
+    def interrupting(self):
+        calls["n"] += 1
+        if calls["n"] == 2:
+            raise KeyboardInterrupt
+        return orig(self)
+
+    monkeypatch.setattr(FunSearch, "evolve_generation", interrupting)
+    fs = evo.run(micro_workload(), cfg, backend=FakeLLM(3),
+                 checkpoint_path=ck, out_dir=str(out), log=quiet)
+    assert fs.best is not None
+    saved = sorted(p.name for p in out.iterdir())
+    assert any(p.startswith("top_policies_") for p in saved)
+    assert any(p.startswith("funsearch_") for p in saved)
+    import os
+    assert os.path.exists(ck)
+
+
 def test_config_from_reference_json(tmp_path):
     p = tmp_path / "llm_config.json"
     p.write_text(json.dumps({
