@@ -47,6 +47,15 @@ fitness) is shared with or identical to the exact engine, so:
 Like the reference, a pod that fails placement when NO deletion is pending
 is silently dropped (event_simulator.py:51-58 falls through) -> unassigned
 -> fitness 0.
+
+Degenerate candidates that refuse many placements retry once per fired
+deletion (quadratic event count — the reference grinds through the same
+blowup without a cap); under the default ``max_steps_factor`` such runs
+hit the step budget and score 0 with ``truncated=True``. The earliest-
+delete rule reaches the cap somewhat more often than the exact engine's
+array-order rule. Raise ``SimConfig.max_steps_factor`` when strict
+handling of pathological candidates matters more than bounding their
+cost.
 """
 from __future__ import annotations
 
