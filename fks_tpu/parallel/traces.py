@@ -24,10 +24,8 @@ import numpy as np
 from fks_tpu.data.entities import ClusterArrays, PodArrays, Workload
 from fks_tpu.models import parametric
 from fks_tpu.parallel.population import ParamPolicyFn
-from fks_tpu.sim.engine import (
-    SimConfig, broadcast_state, build_step, finalize, initial_state,
-    run_batched_lanes,
-)
+from fks_tpu.sim import get_engine
+from fks_tpu.sim.engine import SimConfig
 from fks_tpu.sim.evaluator import max_snapshot_count, snapshot_trigger_table
 
 
@@ -47,15 +45,18 @@ def _strip_ids(wl: Workload) -> Workload:
             "pod_ids": ()}))
 
 
-def stack_traces(workloads: Sequence[Workload], cfg: SimConfig):
+def stack_traces(workloads: Sequence[Workload], cfg: SimConfig,
+                 engine: str = "exact"):
     """Stack same-shape workloads into (workload[T,...], ktable[T,K],
     state0[T,...], max_steps).
 
     Host-side prep: per-trace snapshot tables are sized from each trace's
     REAL pod count (the reference's ``initialize(total_events)``,
     evaluator.py:47-53) then padded with an unreachable sentinel to a shared
-    width; initial heaps are built per trace by real CPython heapq.
+    width; initial states are built per trace by the chosen engine (the
+    exact engine runs real CPython heapq for its starting layout).
     """
+    mod = get_engine(engine)
     if not workloads:
         raise ValueError("no workloads")
     shapes = {(w.cluster.n_padded, w.cluster.g_padded, w.pods.p_padded)
@@ -74,7 +75,7 @@ def stack_traces(workloads: Sequence[Workload], cfg: SimConfig):
     for i, k in enumerate(ktables):
         kt[i, : len(k)] = k
 
-    states = [initial_state(w, cfg) for w in workloads]
+    states = [mod.initial_state(w, cfg) for w in workloads]
     hist_sizes = {s.wait_hist.shape[0] for s in states}
     if len(hist_sizes) != 1:
         raise ValueError(f"wait histogram sizes differ across traces "
@@ -93,7 +94,8 @@ def make_trace_batch_eval(workloads: Sequence[Workload],
                           param_policy: ParamPolicyFn = parametric.score,
                           cfg: SimConfig = SimConfig(),
                           population: bool = False,
-                          jit: bool = True):
+                          jit: bool = True,
+                          engine: str = "exact"):
     """Build ``eval(params) -> SimResult`` batched over the trace axis T.
 
     ``population=False``: params is one candidate, results have leading
@@ -101,18 +103,26 @@ def make_trace_batch_eval(workloads: Sequence[Workload],
     axis -> results [C, T] (fitness of every candidate on every trace from
     one program — the full config-4 matrix).
 
-    Loop scaffold: the engine's ``run_batched_lanes`` over the
-    (nested-)vmapped self-masking step, with the workload itself a traced
-    vmap argument so one compiled program serves every same-shape trace.
+    Loop scaffold: the shared ``run_batched_lanes`` (one while_loop, cond
+    = any of the chosen engine's ``lane_active``) over the (nested-)vmapped
+    self-masking step, with the workload itself a traced vmap argument so
+    one compiled program serves every same-shape trace.
     """
-    wl, kt, state0, max_steps = stack_traces(workloads, cfg)
+    from fks_tpu.sim.engine import run_batched_lanes
+
+    mod = get_engine(engine)
+    wl, kt, state0, max_steps = stack_traces(workloads, cfg, engine)
 
     def step_one(workload, ktable, params, s):
-        return build_step(
+        return mod.build_step(
             workload, lambda pod, nodes: param_policy(params, pod, nodes),
             cfg, ktable, max_steps)(s)
 
-    fin = lambda w, s: finalize(w, cfg, s)  # noqa: E731
+    fin = lambda w, s: mod.finalize(w, cfg, s)  # noqa: E731
+
+    def drive(vstep_bound, s0):
+        return run_batched_lanes(vstep_bound, s0, max_steps,
+                                 active_fn=mod.lane_active)
 
     if population:
         # lanes [C, T]: traces inner, candidates outer
@@ -122,17 +132,15 @@ def make_trace_batch_eval(workloads: Sequence[Workload],
 
         def eval_fn(params):
             pop = jax.tree_util.tree_leaves(params)[0].shape[0]
-            final = run_batched_lanes(
-                lambda s: vstep(wl, kt, params, s),
-                broadcast_state(state0, pop), max_steps)
+            final = drive(lambda s: vstep(wl, kt, params, s),
+                          mod.broadcast_state(state0, pop))
             return vfin(wl, final)
     else:
         vstep = jax.vmap(step_one, in_axes=(0, 0, None, 0))
         vfin = jax.vmap(fin, in_axes=(0, 0))
 
         def eval_fn(params):
-            final = run_batched_lanes(
-                lambda s: vstep(wl, kt, params, s), state0, max_steps)
+            final = drive(lambda s: vstep(wl, kt, params, s), state0)
             return vfin(wl, final)
 
     return jax.jit(eval_fn) if jit else eval_fn

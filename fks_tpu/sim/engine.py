@@ -181,15 +181,19 @@ def build_step(workload: Workload, policy: PolicyFn, cfg: SimConfig,
         pmilli = p.gpu_milli[pod]
         pdur = p.duration[pod]
 
-        # ---- DELETION: refund resources (reference main.py:74-99)
+        # ---- DELETION: refund resources (reference main.py:74-99).
+        # Dense one-hot adds over the tiny node axis, not scatters — TPU
+        # scatters serialize per element (PROFILE.md).
         a = jnp.where(is_del, s.assigned_node[pod], 0)
         di = is_del.astype(jnp.int32)
-        cpu_left = s.cpu_left.at[a].add(di * pcpu)
-        mem_left = s.mem_left.at[a].add(di * pmem)
-        gpu_left = s.gpu_left.at[a].add(di * pngpu)
+        n_iota = jnp.arange(n, dtype=jnp.int32)
+        oh_a = (n_iota == a).astype(jnp.int32) * di  # [N]
+        cpu_left = s.cpu_left + oh_a * pcpu
+        mem_left = s.mem_left + oh_a * pmem
+        gpu_left = s.gpu_left + oh_a * pngpu
         bits = s.assigned_gpus[pod]
         sel_bits = ((bits >> g_iota) & 1).astype(jnp.int32)  # [G]
-        gpu_milli_left = s.gpu_milli_left.at[a].add(di * pmilli * sel_bits)
+        gpu_milli_left = s.gpu_milli_left + oh_a[:, None] * pmilli * sel_bits[None, :]
 
         # ---- CREATION: score every node, strict argmax (main.py:101-111)
         pod_view = PodView(pcpu, pmem, pngpu, pmilli, s.pod_ctime[pod], pdur)
@@ -210,10 +214,12 @@ def build_step(workload: Workload, policy: PolicyFn, cfg: SimConfig,
         alloc_fail = placed & (pngpu > 0) & ~ok  # reference raises here
         pl = placed & ~alloc_fail
         pli = pl.astype(jnp.int32)
-        cpu_left = cpu_left.at[b].add(-pli * pcpu)
-        mem_left = mem_left.at[b].add(-pli * pmem)
-        gpu_left = gpu_left.at[b].add(-pli * pngpu)
-        gpu_milli_left = gpu_milli_left.at[b].add(-pli * pmilli * sel.astype(jnp.int32))
+        oh_b = (n_iota == b).astype(jnp.int32) * pli  # [N]
+        cpu_left = cpu_left - oh_b * pcpu
+        mem_left = mem_left - oh_b * pmem
+        gpu_left = gpu_left - oh_b * pngpu
+        gpu_milli_left = gpu_milli_left - (
+            oh_b[:, None] * pmilli * sel.astype(jnp.int32)[None, :])
 
         was_waiting = s.waiting[pod]
         assigned_node = s.assigned_node.at[pod].set(
@@ -440,18 +446,22 @@ def broadcast_state(state0: SimState, lanes: int) -> SimState:
         state0)
 
 
-def run_batched_lanes(vstep, bstate: SimState, max_steps: int) -> SimState:
+def run_batched_lanes(vstep, bstate, max_steps: int, active_fn=None):
     """Drive any stack of self-masking lanes to completion.
 
     NOT ``vmap(while_loop)``: that would select the entire per-lane carry
-    (heap arrays included) every iteration to freeze finished lanes.
+    (queue arrays included) every iteration to freeze finished lanes.
     Instead the vmapped self-masking step runs INSIDE one ``while_loop``
     whose cond is "any lane active", so a finished lane costs only dropped
-    scatters. ``vstep`` must wrap ``build_step`` lanes (any nesting of
-    vmaps); the cond reuses the exact ``lane_active`` predicate the step
-    masks with."""
+    writes. ``vstep`` must wrap an engine's ``build_step`` lanes (any
+    nesting of vmaps); ``active_fn`` is that engine's ``lane_active`` —
+    the EXACT predicate the step masks with (a cond/step divergence would
+    spin forever). Defaults to this module's. The single shared scaffold
+    for the population, flat-population, and multi-trace paths."""
+    if active_fn is None:
+        active_fn = lane_active
     return jax.lax.while_loop(
-        lambda s: jnp.any(lane_active(s, max_steps)), vstep, bstate)
+        lambda s: jnp.any(active_fn(s, max_steps)), vstep, bstate)
 
 
 def make_population_run_fn(workload: Workload, param_policy,

@@ -70,6 +70,7 @@ from fks_tpu.data.entities import Workload
 from fks_tpu.ops.allocator import best_fit_gpus, first_fit_gpus
 from fks_tpu.sim.engine import (
     SimConfig, _audit, _node_view, finalize_fields, loop_tables,
+    run_batched_lanes,
 )
 from fks_tpu.sim.types import FlatState, NodeView, PodView, PolicyFn, SimResult
 
@@ -215,15 +216,20 @@ def build_step(workload: Workload, policy: PolicyFn, cfg: SimConfig,
         pmilli = p.gpu_milli[pod]
         pdur = p.duration[pod]
 
-        # ---- DELETION: refund resources (reference main.py:74-99)
+        # ---- DELETION: refund resources (reference main.py:74-99).
+        # Node-array updates are DENSE one-hot adds, not scatters: N is
+        # tiny (padded node count) and TPU scatters serialize per element
+        # while a [N]-wide predicated add is one vector op.
         a = jnp.where(is_del, s.assigned_node[pod], 0)
         di = is_del.astype(jnp.int32)
-        cpu_left = s.cpu_left.at[a].add(di * pcpu)
-        mem_left = s.mem_left.at[a].add(di * pmem)
-        gpu_left = s.gpu_left.at[a].add(di * pngpu)
+        n_iota = jnp.arange(c.cpu_total.shape[0], dtype=jnp.int32)
+        oh_a = (n_iota == a).astype(jnp.int32) * di  # [N]
+        cpu_left = s.cpu_left + oh_a * pcpu
+        mem_left = s.mem_left + oh_a * pmem
+        gpu_left = s.gpu_left + oh_a * pngpu
         bits = s.assigned_gpus[pod]
         sel_bits = ((bits >> g_iota) & 1).astype(jnp.int32)  # [G]
-        gpu_milli_left = s.gpu_milli_left.at[a].add(di * pmilli * sel_bits)
+        gpu_milli_left = s.gpu_milli_left + oh_a[:, None] * pmilli * sel_bits[None, :]
 
         # ---- CREATION: strict argmax placement (main.py:101-111)
         pod_view = PodView(pcpu, pmem, pngpu, pmilli, t, pdur)
@@ -243,11 +249,12 @@ def build_step(workload: Workload, policy: PolicyFn, cfg: SimConfig,
         alloc_fail = placed & (pngpu > 0) & ~ok  # reference raises here
         pl = placed & ~alloc_fail
         pli = pl.astype(jnp.int32)
-        cpu_left = cpu_left.at[w].add(-pli * pcpu)
-        mem_left = mem_left.at[w].add(-pli * pmem)
-        gpu_left = gpu_left.at[w].add(-pli * pngpu)
-        gpu_milli_left = gpu_milli_left.at[w].add(
-            -pli * pmilli * sel.astype(jnp.int32))
+        oh_w = (n_iota == w).astype(jnp.int32) * pli  # [N]
+        cpu_left = cpu_left - oh_w * pcpu
+        mem_left = mem_left - oh_w * pmem
+        gpu_left = gpu_left - oh_w * pngpu
+        gpu_milli_left = gpu_milli_left - (
+            oh_w[:, None] * pmilli * sel.astype(jnp.int32)[None, :])
 
         assigned_node = s.assigned_node.at[pod].set(
             jnp.where(pl, w, s.assigned_node[pod]))
@@ -411,9 +418,9 @@ def make_population_run_fn(workload: Workload, param_policy,
                 cfg, ktable, max_steps)(s)
 
         vstep = jax.vmap(step_one, in_axes=(0, 0))
-        final = jax.lax.while_loop(
-            lambda s: jnp.any(lane_active(s, max_steps)),
-            lambda s: vstep(params, s), broadcast_state(state0, pop))
+        final = run_batched_lanes(
+            lambda s: vstep(params, s), broadcast_state(state0, pop),
+            max_steps, active_fn=lane_active)
         return jax.vmap(lambda s: finalize(workload, cfg, s))(final)
 
     return run

@@ -104,3 +104,21 @@ def test_population_by_trace_matrix():
     single = make_trace_batch_eval(wls, cfg=cfg)(pop[1])
     assert np.allclose(np.asarray(res.policy_score[1]),
                        np.asarray(single.policy_score))
+
+
+def test_batched_flat_engine_matches_per_trace():
+    """The flat engine drives the same stacked-trace program shape; each
+    lane equals its independent flat simulation."""
+    from fks_tpu.sim import flat
+
+    cfg = SimConfig(score_dtype=jnp.float64)
+    wls = [small(seed) for seed in range(3)]
+    params = parametric.seed_weights("best_fit")
+    res = make_trace_batch_eval(wls, cfg=cfg, engine="flat")(params)
+    for i, wl in enumerate(wls):
+        run = flat.make_param_run_fn(wl, parametric.score, cfg)
+        single = run(params, flat.initial_state(wl, cfg))
+        assert float(res.policy_score[i]) == pytest.approx(
+            float(single.policy_score), abs=1e-12), i
+        assert np.array_equal(np.asarray(res.assigned_node[i]),
+                              np.asarray(single.assigned_node))
