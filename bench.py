@@ -49,7 +49,41 @@ def log(*a):
     print(*a, file=sys.stderr, flush=True)
 
 
+METRIC = "candidate policy evaluations/sec (8152-pod trace)"
+
+
+def _fail(error: str) -> int:
+    """The benchmark's single-JSON-line contract, error form."""
+    print(json.dumps({"metric": METRIC, "value": 0.0, "unit": "evals/s",
+                      "vs_baseline": 0.0, "error": error}))
+    return 1
+
+
+def _probe_backend(timeout_s: int = 120):
+    """The axon TPU tunnel can WEDGE (hang indefinitely) after a killed
+    device execution; backend init then blocks forever. Probe device
+    discovery in a subprocess first so a wedged tunnel yields an error
+    JSON instead of a hung benchmark. Returns None when healthy, else an
+    error string (real init failures keep their stderr)."""
+    import subprocess
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=timeout_s, capture_output=True, text=True)
+    except subprocess.TimeoutExpired:
+        return "device backend initialization timed out (wedged tunnel?)"
+    if r.returncode != 0:
+        log(f"backend probe failed rc={r.returncode}:\n{r.stderr[-2000:]}")
+        return f"device backend initialization failed (rc={r.returncode})"
+    return None
+
+
 def main():
+    err = _probe_backend()
+    if err:
+        log(f"backend probe: {err}")
+        return _fail(err)
+
     import jax
 
     from fks_tpu.data import TraceParser
@@ -76,11 +110,7 @@ def main():
         got = float(simulate(wl, zoo.ZOO[name]()).policy_score)
         if abs(got - want) > 1e-4:
             log(f"PARITY FAIL {name}: got {got:.6f} want {want:.4f}")
-            print(json.dumps({
-                "metric": "candidate policy evaluations/sec (8152-pod trace)",
-                "value": 0.0, "unit": "evals/s", "vs_baseline": 0.0,
-                "error": f"fitness parity failed for {name}"}))
-            return 1
+            return _fail(f"fitness parity failed for {name}")
         log(f"parity ok {name}: {got:.4f}")
 
     # flat-engine sanity: same trace, documented-retry-rule engine must
@@ -89,11 +119,7 @@ def main():
         got = float(flat.simulate(wl, zoo.ZOO["best_fit"]()).policy_score)
         if abs(got - PARITY["best_fit"]) > 2e-2:
             log(f"FLAT SANITY FAIL best_fit: {got:.4f}")
-            print(json.dumps({
-                "metric": "candidate policy evaluations/sec (8152-pod trace)",
-                "value": 0.0, "unit": "evals/s", "vs_baseline": 0.0,
-                "error": "flat-engine sanity check failed"}))
-            return 1
+            return _fail("flat-engine sanity check failed")
         log(f"flat sanity ok best_fit: {got:.4f} (exact {PARITY['best_fit']})")
 
     # ---- stage 2: throughput, chunked population
@@ -133,7 +159,7 @@ def main():
         f"({[round(t, 3) for t in times]})")
 
     print(json.dumps({
-        "metric": "candidate policy evaluations/sec (8152-pod trace)",
+        "metric": METRIC,
         "value": round(evals_per_sec, 2),
         "unit": "evals/s",
         "vs_baseline": round(evals_per_sec / BASELINE_EVALS_PER_SEC, 3),
