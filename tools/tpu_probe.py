@@ -1,0 +1,82 @@
+"""One-off instrumented TPU timing probe for the bench path.
+
+Streams per-stage wall times so a tunnel kill can't eat the evidence.
+Usage: python -u tools/tpu_probe.py [pops...]
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import numpy as np
+
+
+def log(*a):
+    print(*a, flush=True)
+
+
+def main():
+    pops = [int(x) for x in sys.argv[1:]] or [8, 32]
+    dev = jax.devices()[0]
+    log(f"device: {dev.platform} ({dev.device_kind})")
+
+    from fks_tpu.data import TraceParser
+    from fks_tpu.models import parametric, zoo
+    from fks_tpu.parallel import make_population_eval
+    from fks_tpu.sim import flat
+    from fks_tpu.sim.engine import SimConfig, simulate
+
+    wl = TraceParser().parse_workload()
+    log(f"workload: {wl.num_nodes} nodes x {wl.num_pods} pods")
+
+    # stage 1: exact engine single run (the parity-gate unit)
+    t0 = time.perf_counter()
+    r = simulate(wl, zoo.ZOO["first_fit"]())
+    jax.block_until_ready(r.policy_score)
+    log(f"exact first_fit compile+run: {time.perf_counter() - t0:.1f}s "
+        f"score={float(r.policy_score):.4f}")
+
+    # stage 2: flat engine single run
+    t0 = time.perf_counter()
+    r = flat.simulate(wl, zoo.ZOO["best_fit"]())
+    jax.block_until_ready(r.policy_score)
+    log(f"flat best_fit compile+run: {time.perf_counter() - t0:.1f}s "
+        f"score={float(r.policy_score):.4f} "
+        f"events={int(r.events_processed)} trunc={bool(r.truncated)}")
+
+    run = jax.jit(lambda: flat.simulate(wl, zoo.ZOO['best_fit'](), jit=False))
+    r = run()
+    jax.block_until_ready(r.policy_score)
+    t0 = time.perf_counter()
+    r = run()
+    jax.block_until_ready(r.policy_score)
+    warm = time.perf_counter() - t0
+    ev_n = int(r.events_processed)
+    log(f"flat best_fit warm: {warm:.2f}s = {warm / max(ev_n,1) * 1e6:.1f}"
+        f" us/event ({ev_n} events)")
+
+    # stage 3: flat population chunks (same capped step budget as bench.py)
+    cfg = SimConfig(max_steps=4 * wl.num_pods)
+    for pop in pops:
+        key = jax.random.PRNGKey(0)
+        params = parametric.init_population(key, pop, noise=0.1)
+        ev = make_population_eval(wl, cfg=cfg, engine="flat")
+        t0 = time.perf_counter()
+        res = ev(params)
+        jax.block_until_ready(res.policy_score)
+        log(f"flat pop={pop} compile+run: {time.perf_counter() - t0:.1f}s")
+        t0 = time.perf_counter()
+        res = ev(params)
+        jax.block_until_ready(res.policy_score)
+        dt = time.perf_counter() - t0
+        evs = np.asarray(res.events_processed)
+        tr = np.asarray(res.truncated)
+        log(f"flat pop={pop} warm: {dt:.2f}s = {pop/dt:.1f} evals/s; "
+            f"events max={int(evs.max())} mean={float(evs.mean()):.0f} "
+            f"truncated={int(tr.sum())}")
+
+
+if __name__ == "__main__":
+    main()
