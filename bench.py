@@ -32,8 +32,8 @@ executions over ~60 s and can leave the device wedged afterwards):
    lane in its chunk to the 8x default budget; truncated lanes score 0
    exactly as documented in fks_tpu/sim/flat.py.
 
-Env knobs: FKS_BENCH_POP (total population, default 256),
-FKS_BENCH_CHUNK (per-device-call lanes, default 64),
+Env knobs: FKS_BENCH_POP (total population, default 512),
+FKS_BENCH_CHUNK (per-device-call lanes, default 256),
 FKS_BENCH_REPS (timed repetitions, default 2),
 FKS_BENCH_ENGINE (flat|exact, default flat),
 FKS_BENCH_DEADLINE_S (controller budget for ALL stages, default 2400).
@@ -62,19 +62,25 @@ def _fail(error: str) -> int:
     return 1
 
 
-def _probe_backend(timeout_s: int = 120, attempts: int = 3):
+def _probe_backend(budget_s: int, attempts: int = 3):
     """The axon TPU tunnel can WEDGE (hang indefinitely) after a killed
     device execution; backend init then blocks forever. Probe device
     discovery in a subprocess so a wedged tunnel yields an error JSON
     instead of a hung benchmark. Wedges drain when the remote side
-    finishes the orphaned execution, so retry a few times before giving
-    up. Returns None when healthy, else an error string."""
+    finishes the orphaned execution, so retry while the budget lasts.
+    ALL attempts and inter-attempt sleeps stay inside ``budget_s`` (the
+    controller promises the driver a JSON line within its deadline).
+    Returns None when healthy, else an error string."""
+    deadline = time.monotonic() + budget_s
     last = None
     for i in range(attempts):
+        remaining = deadline - time.monotonic()
+        if remaining < 10:
+            break
         try:
             r = subprocess.run(
                 [sys.executable, "-c", "import jax; jax.devices()"],
-                timeout=timeout_s, capture_output=True, text=True)
+                timeout=min(120, remaining), capture_output=True, text=True)
         except subprocess.TimeoutExpired:
             last = "device backend initialization timed out (wedged tunnel?)"
             log(f"backend probe attempt {i + 1}/{attempts}: {last}")
@@ -84,7 +90,7 @@ def _probe_backend(timeout_s: int = 120, attempts: int = 3):
             log(f"backend probe attempt {i + 1}/{attempts} rc={r.returncode}:"
                 f"\n{r.stderr[-2000:]}")
             if i + 1 < attempts:
-                time.sleep(30)
+                time.sleep(max(0, min(30, deadline - time.monotonic())))
             continue
         return None
     return last
@@ -141,7 +147,7 @@ def stage_throughput(pop: int, chunk: int, reps: int, engine: str) -> int:
     # retry traffic (retry-heavy champions reach ~28k events) while keeping
     # one degenerate lane from holding its chunk to the 8x default budget
     # (truncated lanes score 0; see module docstring).
-    cfg = SimConfig(max_steps=4 * wl.num_pods)
+    cfg = SimConfig(max_steps=4 * wl.num_pods, track_ctime=False)
     key = jax.random.PRNGKey(0)
     params = parametric.init_population(key, pop, noise=0.1)
     ev = make_population_eval(wl, cfg=cfg, engine=engine)
@@ -213,8 +219,8 @@ def main():
     stage = ""
     if "--stage" in sys.argv:
         stage = sys.argv[sys.argv.index("--stage") + 1]
-    pop = int(os.environ.get("FKS_BENCH_POP", "256"))
-    chunk = min(int(os.environ.get("FKS_BENCH_CHUNK", "64")), pop)
+    pop = int(os.environ.get("FKS_BENCH_POP", "512"))
+    chunk = min(int(os.environ.get("FKS_BENCH_CHUNK", "256")), pop)
     reps = int(os.environ.get("FKS_BENCH_REPS", "2"))
     engine = os.environ.get("FKS_BENCH_ENGINE", "flat")
 
@@ -247,7 +253,7 @@ def main():
         return _fail("parity gate did not pass (fitness mismatch, "
                      "timeout, or crash — see stderr)")
 
-    err = _probe_backend(timeout_s=min(120, max(30, budget() // 4)))
+    err = _probe_backend(budget_s=max(30, budget() - 180))
     if err:
         log(f"backend probe: {err}")
         return _fail(err)
@@ -269,8 +275,8 @@ def main():
         log(f"retrying throughput with chunk={chunk} pop={pop}")
         if budget() < 120:
             return _fail("benchmark deadline exhausted")
-        # keep the probe inside the deadline too: 3 attempts must fit
-        err = _probe_backend(timeout_s=min(120, budget() // 3))
+        # keep the probe inside the deadline too (leave room for the rerun)
+        err = _probe_backend(budget_s=max(30, budget() - 180))
         if err:
             log(f"backend probe: {err}")
             return _fail(err)

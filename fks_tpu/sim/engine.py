@@ -66,6 +66,14 @@ class SimConfig:
     # unbatched — under vmap, cond degenerates to executing both branches,
     # so batched paths should keep this off.
     cond_policy: bool = False
+    # maintain SimResult.pod_ctime (retry-mutated creation times, reference
+    # event_simulator.py:56). Pure bookkeeping — nothing downstream of the
+    # simulation reads it — but in the flat engine the write is a full
+    # [P]-wide blend per event, so throughput-only paths (bench, population
+    # fitness) turn it off. When off, SimResult.pod_ctime holds the
+    # original creation times. The exact engine always tracks (its scatter
+    # write is not on the critical path).
+    track_ctime: bool = True
 
     def resolve_max_steps(self, num_pods: int) -> int:
         if self.max_steps is not None:

@@ -3,29 +3,37 @@
 Why a second engine: the exact engine (fks_tpu.sim.engine) replicates the
 reference's CPython heap bit-for-bit (required for the layout-dependent
 retry rule, reference: simulator/event_simulator.py:51-58), but heap sifts
-are chains of ~14 dependent tiny gather/scatters per event — measured at
-~11 us/lane/step on a v5e chip, they dominate the step and scale LINEARLY
-with the vmapped population (tools/profile_step.py; PROFILE.md). TPUs are
-throughput machines: they want contiguous slices and vector reduces, not
-pointer-chasing.
+are chains of ~14 dependent tiny gather/scatters per event — the worst
+possible shape for a TPU. Measurement on a v5e chip (tools/probe_ops.py,
+PROFILE.md) showed something stronger: EVERY per-lane-indexed scatter or
+gather in a vmapped loop body costs ~35 us/step of serialized latency,
+while full-array vector passes (reduces, dense blends) run at HBM
+bandwidth. So this engine is built from exactly two kinds of op:
 
-This engine replaces the heap with a structure a TPU likes:
+- **Full-sweep pops.** One slot per pod (a pod has at most ONE pending
+  event: CREATE / retried CREATE / DELETE), ``ev_time[Q]`` with INF for
+  empty. Slots are ordered by ``tie_rank`` (pod-id string rank, the
+  reference's equal-time tie-break, event_simulator.py:16-17), so the next
+  event is simply ``argmin(ev_time)`` — argmin's first-index tie rule IS
+  the reference's tie rule, with no rank array and no lexicographic
+  two-pass reduce.
+- **Dense one-hot blends.** Every state write (the popped slot's rewrite,
+  node refunds/placements, the waiting histogram) is a predicated
+  full-array ``where``, never a scatter. XLA fuses the blends that share a
+  mask into single bandwidth-bound passes.
 
-- **One slot per pod.** At any instant a pod has at most ONE pending event
-  (its CREATE, a retried CREATE, or its DELETE) — so the queue is just
-  ``ev_time[P]`` + ``ev_kind[P]``, and every step rewrites exactly one
-  slot. No sifts, no layout.
-- **Two-level min hierarchy.** Pop = lexicographic argmin over
-  ``(time, tie_rank)``. Slots are grouped into B blocks of ``block`` pods;
-  the carry holds each block's (min time, min rank) and min pending-DELETE
-  time. A step touches one block: one contiguous ``dynamic_slice`` in,
-  in-register recompute, one contiguous ``dynamic_update_slice`` out.
-  Per-step HBM traffic is O(block), independent of P.
-- **Pop order is EXACTLY the reference's** wherever the reference's own
-  order is well-defined: keys ``(time, tie_rank)`` are unique per pod
-  (tie_rank = pod-id rank, event_simulator.py:16-17), and a pod's CREATE
-  always precedes its own DELETE because the DELETE only enters the queue
-  when the CREATE is placed (event_simulator.py:45-49).
+A companion ``aux[Q]`` array carries each pod's scheduling state in one
+int32: -1 = CREATE pending / never placed, -2 = in the waiting set
+(failed at least once), >= 0 = placed, packed ``(node << G) | gpu_bits``
+(falls back to a separate gpu-bits array when node_bits + G > 31). The
+pop's kind test, the pending-DELETE minimum for the retry rule, the
+was-waiting flag, and the final assigned/unassigned verdict all read this
+one array, so the whole step touches O(Q) bytes across ~3 fused passes.
+
+Pop order is EXACTLY the reference's wherever the reference's own order is
+well-defined: keys ``(time, tie_rank)`` are unique per pod, and a pod's
+CREATE always precedes its own DELETE because the DELETE only enters the
+queue when the CREATE is placed (event_simulator.py:45-49).
 
 Divergence from the reference, by design (SURVEY.md §7 explicitly blesses
 this): the retry time for an unplaceable pod is ``1 + (earliest pending
@@ -60,7 +68,7 @@ cost.
 from __future__ import annotations
 
 import functools
-from typing import Callable
+from typing import Any, Callable, NamedTuple
 
 import jax
 import jax.numpy as jnp
@@ -72,52 +80,45 @@ from fks_tpu.sim.engine import (
     SimConfig, _audit, _node_view, finalize_fields, loop_tables,
     run_batched_lanes,
 )
-from fks_tpu.sim.types import FlatState, NodeView, PodView, PolicyFn, SimResult
+from fks_tpu.sim.types import FlatState, PodView, PolicyFn, SimResult
 
-INF = jnp.iinfo(jnp.int32).max  # empty-slot sentinel (also "rank" filler)
+INF = jnp.iinfo(jnp.int32).max  # empty-slot sentinel
 
-K_CREATE = 0   # original creation event
-K_DELETE = 1   # pending deletion of a placed pod
-K_RETRY = 2    # re-queued creation (pod is in the waiting set)
-
-
-def _block_width(p_padded: int) -> int:
-    return min(128, max(1, p_padded))
+# aux[q] scheduling-state encoding (one int32 per pod)
+AUX_FRESH = -1    # CREATE pending, never failed
+AUX_WAITING = -2  # retried CREATE pending or dropped (in the waiting set)
+# aux >= 0: placed -- (node << G) | gpu_bits when packable, else node index
 
 
-def _queue_size(p_padded: int) -> int:
-    """Slot-array length: p_padded rounded up to a whole number of blocks.
-    The queue pads internally (INF slots) so ANY workload padding works —
-    callers are not required to pad pod counts to a block multiple."""
-    bw = _block_width(p_padded)
-    return ((p_padded + bw - 1) // bw) * bw
+def _node_bits(n_padded: int) -> int:
+    return max(1, (max(n_padded, 1) - 1).bit_length())
 
 
-def _block_mins(bt, bk, br):
-    """(min time, rank at that min, min DELETE time) of one block slice.
-    Lexicographic (time, rank): ranks are unique, so the pair is unique."""
-    mt = jnp.min(bt)
-    mr = jnp.min(jnp.where(bt == mt, br, INF))
-    mdel = jnp.min(jnp.where(bk == K_DELETE, bt, INF))
-    return mt, mr, mdel
+def _packable(n_padded: int, g_padded: int) -> bool:
+    """Can (node, gpu_bits) share one non-negative int32?"""
+    return _node_bits(n_padded) + g_padded <= 31
+
+
+def _rank_perm(pod_mask, tie_rank):
+    """Slot order: real pods by ascending tie_rank, padding last. Stable
+    argsort, so host (numpy) and device (jnp) agree for the same input."""
+    if isinstance(pod_mask, np.ndarray):
+        key = np.where(pod_mask, tie_rank, INF)
+        return np.argsort(key, kind="stable").astype(np.int32)
+    key = jnp.where(pod_mask, tie_rank, INF)
+    return jnp.argsort(key, stable=True).astype(jnp.int32)
 
 
 def initial_state(workload: Workload, cfg: SimConfig) -> FlatState:
-    """t=0 carry: every real pod's slot holds its CREATE event."""
+    """t=0 carry: every real pod's slot (in tie-rank order) holds its
+    CREATE time; ``aux`` starts at AUX_FRESH."""
     c, p = workload.cluster, workload.pods
     pp = p.p_padded
-    qp = _queue_size(pp)
-    bw = _block_width(pp)
     pm = np.asarray(p.pod_mask)
-    ev_time = np.full(qp, INF, np.int32)
-    ev_time[:pp] = np.where(pm, np.asarray(p.creation_time), INF)
-    ev_kind = np.zeros(qp, np.int32)
-    rank = np.full(qp, INF, np.int32)
-    rank[:pp] = np.where(pm, np.asarray(p.tie_rank), INF)
-    tb = ev_time.reshape(-1, bw)
-    rb = rank.reshape(-1, bw)
-    bmin_t = tb.min(axis=1)
-    bmin_r = np.where(tb == bmin_t[:, None], rb, INF).min(axis=1)
+    perm = _rank_perm(pm, np.asarray(p.tie_rank))
+    r_mask = pm[perm]
+    ev_time = np.where(r_mask, np.asarray(p.creation_time)[perm], INF)
+    packed = _packable(c.n_padded, c.g_padded)
 
     max_milli = int(np.asarray(p.gpu_milli).max(initial=0))
     hist_size = (cfg.wait_hist_size if cfg.wait_hist_size is not None
@@ -128,18 +129,15 @@ def initial_state(workload: Workload, cfg: SimConfig) -> FlatState:
             "fragmentation min_needed would be miscounted")
     f = cfg.score_dtype
     return FlatState(
-        ev_time=jnp.asarray(ev_time),
-        ev_kind=jnp.asarray(ev_kind),
-        bmin_t=jnp.asarray(bmin_t, jnp.int32),
-        bmin_r=jnp.asarray(bmin_r, jnp.int32),
-        bdel_t=jnp.full(bmin_t.shape, INF, jnp.int32),
+        ev_time=jnp.asarray(ev_time, jnp.int32),
+        aux=jnp.full(pp, AUX_FRESH, jnp.int32),
+        aux_gpus=None if packed else jnp.zeros(pp, jnp.uint32),
+        pending=jnp.int32(int(pm.sum())),
         cpu_left=jnp.asarray(c.cpu_total, jnp.int32),
         mem_left=jnp.asarray(c.mem_total, jnp.int32),
         gpu_left=jnp.asarray(c.gpu_declared, jnp.int32),
         gpu_milli_left=jnp.asarray(c.gpu_milli_total, jnp.int32),
-        assigned_node=jnp.full(pp, -1, jnp.int32),
-        assigned_gpus=jnp.zeros(pp, jnp.uint32),
-        pod_ctime=jnp.asarray(p.creation_time, jnp.int32),
+        pod_ctime=jnp.asarray(np.asarray(p.creation_time)[perm], jnp.int32),
         wait_hist=jnp.zeros(hist_size, jnp.int32),
         events_processed=jnp.int32(0),
         snap_idx=jnp.int32(0),
@@ -155,83 +153,95 @@ def initial_state(workload: Workload, cfg: SimConfig) -> FlatState:
 
 def lane_active(s: FlatState, max_steps: int):
     """Termination predicate (single source of truth for the loop cond and
-    the step's self-masking, like engine.lane_active).
-
-    The block-min reduction is over the LAST axis only: on the batched
-    state ``bmin_t`` is [lanes, B] and the predicate must stay per-lane —
-    a full reduction would let one truncated lane (pending events, step
-    budget exhausted) hold the population loop's cond true through other
-    lanes forever."""
-    return ((jnp.min(s.bmin_t, axis=-1) < INF)
-            & ~s.failed & (s.steps < max_steps))
+    the step's self-masking). ``pending`` counts live slots, maintained
+    incrementally so neither the cond nor the predicate needs a full
+    ev_time sweep."""
+    return (s.pending > 0) & ~s.failed & (s.steps < max_steps)
 
 
 def build_step(workload: Workload, policy: PolicyFn, cfg: SimConfig,
                ktable, max_steps: int) -> Callable[[FlatState], FlatState]:
     """One event. Self-masking like the exact engine's step, so the
-    population layer can run ONE while_loop over vmapped lanes."""
+    population layer can run ONE while_loop over vmapped lanes.
+
+    ``workload`` arrays may be tracers (multi-trace batching); everything
+    derived from them (the rank permutation, permuted pod features, totals)
+    is loop-invariant, so XLA hoists it out of the while_loop either way.
+    """
     c, p = workload.cluster, workload.pods
     c = jax.tree_util.tree_map(jnp.asarray, c)
     p = jax.tree_util.tree_map(jnp.asarray, p)
     pp = p.p_padded
-    qp = _queue_size(pp)
-    bw = _block_width(pp)
+    n = workload.cluster.n_padded
     g = workload.cluster.g_padded
     f = cfg.score_dtype
     alloc = best_fit_gpus if cfg.gpu_allocator == "best_fit" else first_fit_gpus
+    packed = _packable(n, g)
     total_cpu = jnp.sum(c.cpu_total)
     total_mem = jnp.sum(c.mem_total)
     total_gc = jnp.sum(c.num_gpus)
     total_gm = jnp.sum(c.gpu_milli_total)
     g_iota = jnp.arange(g, dtype=jnp.uint32)
-    bw_iota = jnp.arange(bw, dtype=jnp.int32)
+    n_iota = jnp.arange(n, dtype=jnp.int32)
+    q_iota = jnp.arange(pp, dtype=jnp.int32)
     ktable = jnp.asarray(ktable, jnp.int32)
     klen = ktable.shape[0]
-    rank_arr = jnp.full(qp, INF, jnp.int32).at[:pp].set(
-        jnp.where(p.pod_mask, p.tie_rank, INF).astype(jnp.int32))
+
+    # pod features permuted into slot (tie-rank) order, packed into one
+    # gather table so the pop costs a single [8]-row read
+    perm = _rank_perm(p.pod_mask, p.tie_rank)
+    feat = jnp.stack([
+        p.cpu[perm], p.mem[perm], p.num_gpu[perm], p.gpu_milli[perm],
+        p.duration[perm], jnp.zeros(pp, jnp.int32), jnp.zeros(pp, jnp.int32),
+        jnp.zeros(pp, jnp.int32)], axis=-1).astype(jnp.int32)  # [Q, 8]
+    if cfg.validate_invariants:
+        import dataclasses as _dc
+        p_rank = _dc.replace(
+            p, cpu=p.cpu[perm], mem=p.mem[perm], num_gpu=p.num_gpu[perm],
+            gpu_milli=p.gpu_milli[perm], creation_time=p.creation_time[perm],
+            duration=p.duration[perm], tie_rank=p.tie_rank[perm],
+            pod_mask=p.pod_mask[perm])
 
     def step(s: FlatState) -> FlatState:
         active = lane_active(s, max_steps)
 
-        # ---- pop: two-level lexicographic argmin over (time, rank)
-        gt = jnp.min(s.bmin_t)
-        cand = s.bmin_t == gt
-        gr = jnp.min(jnp.where(cand, s.bmin_r, INF))
-        b = jnp.argmax(cand & (s.bmin_r == gr)).astype(jnp.int32)
-        start = b * bw
-        bt = jax.lax.dynamic_slice_in_dim(s.ev_time, start, bw)
-        bk = jax.lax.dynamic_slice_in_dim(s.ev_kind, start, bw)
-        br = jax.lax.dynamic_slice_in_dim(rank_arr, start, bw)
-        off = jnp.argmax((bt == gt) & (br == gr)).astype(jnp.int32)
-        pod = start + off
-        t = gt
-        kind = bk[off]
-        is_del = active & (kind == K_DELETE)
-        create = active & (kind != K_DELETE)
-        was_waiting = kind == K_RETRY
+        # ---- pop + retry-rule minimum: ONE fused sweep over ev_time/aux.
+        # Slot order == tie-rank order, so argmin's first-index tie-break
+        # IS the reference's pod-id tie rule (event_simulator.py:16-17).
+        t = jnp.min(s.ev_time)
+        sidx = jnp.argmin(s.ev_time).astype(jnp.int32)
+        next_del = jnp.min(jnp.where(s.aux >= 0, s.ev_time, INF))
 
-        pcpu = p.cpu[pod]
-        pmem = p.mem[pod]
-        pngpu = p.num_gpu[pod]
-        pmilli = p.gpu_milli[pod]
-        pdur = p.duration[pod]
+        pf = feat[sidx]  # [8]
+        pcpu, pmem, pngpu, pmilli, pdur = pf[0], pf[1], pf[2], pf[3], pf[4]
+        aux_s = s.aux[sidx]
+        is_del = active & (aux_s >= 0)
+        create = active & (aux_s < 0)
+        was_waiting = aux_s == AUX_WAITING
+
+        if packed:
+            held_node = aux_s >> g
+            held_bits = (aux_s & ((1 << g) - 1)).astype(jnp.uint32)
+        else:
+            held_node = aux_s
+            held_bits = s.aux_gpus[sidx]
 
         # ---- DELETION: refund resources (reference main.py:74-99).
-        # Node-array updates are DENSE one-hot adds, not scatters: N is
-        # tiny (padded node count) and TPU scatters serialize per element
-        # while a [N]-wide predicated add is one vector op.
-        a = jnp.where(is_del, s.assigned_node[pod], 0)
+        # Node-array updates are DENSE one-hot adds over the tiny node
+        # axis, never scatters.
+        a = jnp.where(is_del, held_node, 0)
         di = is_del.astype(jnp.int32)
-        n_iota = jnp.arange(c.cpu_total.shape[0], dtype=jnp.int32)
         oh_a = (n_iota == a).astype(jnp.int32) * di  # [N]
         cpu_left = s.cpu_left + oh_a * pcpu
         mem_left = s.mem_left + oh_a * pmem
         gpu_left = s.gpu_left + oh_a * pngpu
-        bits = s.assigned_gpus[pod]
-        sel_bits = ((bits >> g_iota) & 1).astype(jnp.int32)  # [G]
+        sel_bits = ((held_bits >> g_iota) & 1).astype(jnp.int32)  # [G]
         gpu_milli_left = s.gpu_milli_left + oh_a[:, None] * pmilli * sel_bits[None, :]
 
-        # ---- CREATION: strict argmax placement (main.py:101-111)
+        # ---- CREATION: strict argmax placement (main.py:101-111).
+        # creation_time == pop time for both fresh and retried pods (the
+        # reference mutates pod.creation_time to the retry time, so at pop
+        # it always equals the event time).
         pod_view = PodView(pcpu, pmem, pngpu, pmilli, t, pdur)
         node_view = _node_view(c, cpu_left, mem_left, gpu_left, gpu_milli_left)
         if cfg.cond_policy:
@@ -255,20 +265,16 @@ def build_step(workload: Workload, policy: PolicyFn, cfg: SimConfig,
         gpu_left = gpu_left - oh_w * pngpu
         gpu_milli_left = gpu_milli_left - (
             oh_w[:, None] * pmilli * sel.astype(jnp.int32)[None, :])
-
-        assigned_node = s.assigned_node.at[pod].set(
-            jnp.where(pl, w, s.assigned_node[pod]))
         new_bits = jnp.sum(jnp.where(sel, jnp.uint32(1) << g_iota,
                                      jnp.uint32(0)), dtype=jnp.uint32)
-        assigned_gpus = s.assigned_gpus.at[pod].set(
-            jnp.where(pl, new_bits, bits))
 
         # ---- failed creation: waiting set + fragmentation + retry
         failp = create & ~placed
         bucket = jnp.clip(pmilli, 0, s.wait_hist.shape[0] - 1)
-        hist = s.wait_hist.at[bucket].add(
-            (failp & ~was_waiting & (pngpu > 0)).astype(jnp.int32)
-            - (pl & was_waiting & (pngpu > 0)).astype(jnp.int32))
+        hdelta = ((failp & ~was_waiting & (pngpu > 0)).astype(jnp.int32)
+                  - (pl & was_waiting & (pngpu > 0)).astype(jnp.int32))
+        h_iota = jnp.arange(s.wait_hist.shape[0], dtype=jnp.int32)
+        hist = s.wait_hist + (h_iota == bucket).astype(jnp.int32) * hdelta
 
         hvals = hist > 0
         has_gpu_waiting = jnp.any(hvals)
@@ -285,30 +291,32 @@ def build_step(workload: Workload, policy: PolicyFn, cfg: SimConfig,
         frag_count = s.frag_count + failp.astype(jnp.int32)
 
         # retry rule (defined semantics; see module docstring): 1 + the
-        # EARLIEST pending DELETE time. Instrumenting the reference shows
-        # its array-order scan picks the time-earliest pending delete in
-        # the median case (mean rank 0.8 among pending deletes; measured
-        # on the default trace), so this is also the closest principled
-        # approximation of the reference's cadence.
-        next_del = jnp.min(s.bdel_t)
+        # EARLIEST pending DELETE time. ``next_del`` is from the pre-step
+        # sweep, which is exactly the post-pop pending-delete set (the
+        # popped event is a CREATE here, and this step adds no deletes
+        # before the reference's scan point).
         found = next_del < INF
         retry = failp & found
+        dropped = failp & ~found
         rt = next_del + 1
-        pod_ctime = s.pod_ctime.at[pod].set(
-            jnp.where(retry, rt, s.pod_ctime[pod]))
 
-        # ---- slot rewrite: the popped pod's next event
+        # ---- slot rewrite + pod bookkeeping: one fused blend pass
         new_t = jnp.where(pl, t + pdur, jnp.where(retry, rt, INF))
-        new_k = jnp.where(pl, K_DELETE, K_RETRY)
-        bt2 = jnp.where(active & (bw_iota == off), new_t, bt)
-        bk2 = jnp.where(active & (bw_iota == off), new_k, bk)
-        ev_time = jax.lax.dynamic_update_slice_in_dim(s.ev_time, bt2, start, 0)
-        ev_kind = jax.lax.dynamic_update_slice_in_dim(s.ev_kind, bk2, start, 0)
-        mt, mr, mdel = _block_mins(bt2, bk2, br)
-        upd = active
-        bmin_t = s.bmin_t.at[b].set(jnp.where(upd, mt, s.bmin_t[b]))
-        bmin_r = s.bmin_r.at[b].set(jnp.where(upd, mr, s.bmin_r[b]))
-        bdel_t = s.bdel_t.at[b].set(jnp.where(upd, mdel, s.bdel_t[b]))
+        if packed:
+            enc = (w << g) | new_bits.astype(jnp.int32)
+        else:
+            enc = w
+        new_aux = jnp.where(pl, enc, jnp.where(failp, AUX_WAITING, aux_s))
+        m = (q_iota == sidx) & active
+        ev_time = jnp.where(m, new_t, s.ev_time)
+        aux = jnp.where(m, new_aux, s.aux)
+        aux_gpus = s.aux_gpus
+        if not packed:
+            aux_gpus = jnp.where(
+                m, jnp.where(pl, new_bits, held_bits), s.aux_gpus)
+        pod_ctime = (jnp.where(m & retry, rt, s.pod_ctime)
+                     if cfg.track_ctime else s.pod_ctime)
+        pending = s.pending - (is_del | dropped).astype(jnp.int32)
 
         # ---- evaluator bookkeeping (identical to the exact engine)
         valid = active & ~alloc_fail
@@ -334,19 +342,16 @@ def build_step(workload: Workload, policy: PolicyFn, cfg: SimConfig,
 
         violations = s.violations
         if cfg.validate_invariants:
-            # slice off the queue's block padding: the audit segment-sums
-            # against [pp]-shaped per-pod request arrays
-            active_pods = (ev_kind[:pp] == K_DELETE) & (ev_time[:pp] < INF)
+            active_pods = (aux >= 0) & (ev_time < INF)
+            an, ag = _decode_assignment(aux, aux_gpus, g, packed)
             violations = violations + active.astype(jnp.int32) * _audit(
-                c, p, active_pods, cpu_left, mem_left, gpu_left,
-                gpu_milli_left, assigned_node, assigned_gpus)
+                c, p_rank, active_pods, cpu_left, mem_left, gpu_left,
+                gpu_milli_left, an, ag)
 
         return FlatState(
-            ev_time=ev_time, ev_kind=ev_kind,
-            bmin_t=bmin_t, bmin_r=bmin_r, bdel_t=bdel_t,
+            ev_time=ev_time, aux=aux, aux_gpus=aux_gpus, pending=pending,
             cpu_left=cpu_left, mem_left=mem_left, gpu_left=gpu_left,
-            gpu_milli_left=gpu_milli_left, assigned_node=assigned_node,
-            assigned_gpus=assigned_gpus, pod_ctime=pod_ctime,
+            gpu_milli_left=gpu_milli_left, pod_ctime=pod_ctime,
             wait_hist=hist, events_processed=events, snap_idx=snap_idx,
             snap_sums=snap_sums, frag_sum=frag_sum, frag_count=frag_count,
             max_nodes=max_nodes, failed=s.failed | alloc_fail,
@@ -356,9 +361,57 @@ def build_step(workload: Workload, policy: PolicyFn, cfg: SimConfig,
     return step
 
 
+def _decode_assignment(aux, aux_gpus, g: int, packed: bool):
+    """(assigned_node[Q], assigned_gpus[Q]) from the aux encoding (slot
+    order). Placed pods keep aux >= 0 after their DELETE fires, so this is
+    valid mid-run and at finalize."""
+    if packed:
+        an = jnp.where(aux >= 0, aux >> g, -1)
+        ag = jnp.where(aux >= 0, (aux & ((1 << g) - 1)).astype(jnp.uint32),
+                       jnp.uint32(0))
+    else:
+        an = jnp.where(aux >= 0, aux, -1)
+        ag = jnp.where(aux >= 0, aux_gpus, jnp.uint32(0))
+    return an, ag
+
+
+class _FinalView(NamedTuple):
+    """finalize_fields-compatible view of a FlatState with per-pod arrays
+    decoded from aux and un-permuted back to input (CSV) order."""
+
+    assigned_node: Any
+    assigned_gpus: Any
+    pod_ctime: Any
+    cpu_left: Any
+    mem_left: Any
+    gpu_left: Any
+    gpu_milli_left: Any
+    events_processed: Any
+    snap_idx: Any
+    snap_sums: Any
+    frag_sum: Any
+    frag_count: Any
+    max_nodes: Any
+    failed: Any
+    violations: Any
+
+
 def finalize(workload: Workload, cfg: SimConfig, s: FlatState) -> SimResult:
-    return finalize_fields(
-        workload, cfg, pending=jnp.min(s.bmin_t) < INF, s=s)
+    c, p = workload.cluster, workload.pods
+    perm = _rank_perm(jnp.asarray(p.pod_mask), jnp.asarray(p.tie_rank))
+    inv = jnp.argsort(perm)  # slot index of each input-order pod
+    an, ag = _decode_assignment(
+        s.aux, s.aux_gpus, c.g_padded, _packable(c.n_padded, c.g_padded))
+    view = _FinalView(
+        assigned_node=an[inv], assigned_gpus=ag[inv],
+        pod_ctime=s.pod_ctime[inv],
+        cpu_left=s.cpu_left, mem_left=s.mem_left, gpu_left=s.gpu_left,
+        gpu_milli_left=s.gpu_milli_left,
+        events_processed=s.events_processed, snap_idx=s.snap_idx,
+        snap_sums=s.snap_sums, frag_sum=s.frag_sum, frag_count=s.frag_count,
+        max_nodes=s.max_nodes, failed=s.failed, violations=s.violations,
+    )
+    return finalize_fields(workload, cfg, pending=s.pending > 0, s=view)
 
 
 def make_param_run_fn(workload: Workload, param_policy,

@@ -81,23 +81,24 @@ class SimState(NamedTuple):
 
 class FlatState(NamedTuple):
     """The flat engine's while_loop carry (fks_tpu.sim.flat): slot-per-pod
-    event queue + per-block min hierarchy + the SAME cluster/evaluator
-    fields as SimState (finalize_fields consumes either)."""
+    event queue in tie-rank order + the SAME cluster/evaluator fields as
+    SimState. Per-pod arrays are in SLOT (tie-rank) order; finalize
+    un-permutes them back to input order."""
 
-    # event queue: one slot per pod + two-level min index
-    ev_time: Any  # i32[P]; INF = no pending event
-    ev_kind: Any  # i32[P]; 0=CREATE 1=DELETE 2=RETRY-CREATE
-    bmin_t: Any  # i32[B] per-block min event time
-    bmin_r: Any  # i32[B] tie rank at that min
-    bdel_t: Any  # i32[B] per-block min pending-DELETE time, INF if none
-    # cluster + pod scheduling state (as SimState)
+    # event queue: one slot per pod, slots sorted by tie_rank
+    ev_time: Any  # i32[Q]; INF = no pending event
+    # per-pod scheduling state in ONE int32: -1 fresh CREATE pending,
+    # -2 waiting (failed at least once), >= 0 placed: (node << G)|gpu_bits
+    # when packable, else the node index with bits in aux_gpus
+    aux: Any  # i32[Q]
+    aux_gpus: Any  # u32[Q] gpu bitmask, or None when packed into aux
+    pending: Any  # i32 live-slot count (loop-cond scalar)
+    # cluster state (as SimState)
     cpu_left: Any
     mem_left: Any
     gpu_left: Any
     gpu_milli_left: Any
-    assigned_node: Any
-    assigned_gpus: Any
-    pod_ctime: Any
+    pod_ctime: Any  # i32[Q] creation time, retry-mutated (slot order)
     wait_hist: Any
     # evaluator accumulators (as SimState)
     events_processed: Any

@@ -1,0 +1,201 @@
+"""Characterize the per-iteration floor of device loops on this backend.
+
+probe_ops.py showed a ~35 us/step cost that is nearly independent of lane
+count (16..256) AND of body op type (1-element scatter == full [L,8192]
+dense blend). This probe isolates what that floor is made of and whether
+``lax.scan`` with ``unroll`` amortizes it:
+
+  A. empty while_loop, carry sizes from scalar to [256,8192]x2
+  B. same bodies under scan(length, unroll in {1,4,8,16})
+  C. a composite "sweep step" shaped like the planned scatter-free flat
+     engine body (argmin over [L,Q] + one-hot blends + small node math),
+     while vs scan-unroll, lanes in {64, 256}
+
+Output feeds PROFILE.md and the flat-engine redesign.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+INF = jnp.iinfo(jnp.int32).max
+
+
+def timed(fn, *args):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    out = fn(*args)
+    jax.block_until_ready(out)
+    return time.perf_counter() - t0
+
+
+def while_loop(body, carry0, steps):
+    def cond(c):
+        return c[0] < steps
+
+    def wrapped(c):
+        i, x = c
+        return (i + 1, body(i, x))
+
+    return jax.lax.while_loop(cond, wrapped, (jnp.int32(0), carry0))
+
+
+def scan_loop(body, carry0, steps, unroll):
+    def f(c, _):
+        i, x = c
+        return (i + 1, body(i, x)), None
+
+    out, _ = jax.lax.scan(f, (jnp.int32(0), carry0), None, length=steps,
+                          unroll=unroll)
+    return out
+
+
+def part_a_b(steps):
+    print("== A/B: empty-ish bodies, while vs scan(unroll) ==", flush=True)
+    shapes = {
+        "scalar": lambda: jnp.int32(0),
+        "[64,16]": lambda: jnp.zeros((64, 16), jnp.int32),
+        "[64,8192]": lambda: jnp.zeros((64, 8192), jnp.int32),
+        "[64,8192]x2": lambda: (jnp.zeros((64, 8192), jnp.int32),
+                                jnp.zeros((64, 8192), jnp.int32)),
+        "[256,8192]x2": lambda: (jnp.zeros((256, 8192), jnp.int32),
+                                 jnp.zeros((256, 8192), jnp.int32)),
+    }
+
+    def touch(i, c):
+        # minimal data-dependent touch so nothing folds away
+        return jax.tree_util.tree_map(lambda x: x + i, c)
+
+    for name, mk in shapes.items():
+        c0 = mk()
+        t_w = timed(jax.jit(lambda c: while_loop(touch, c, steps)), c0)
+        row = [f"while {t_w / steps * 1e6:8.2f}"]
+        for u in (1, 8, 16):
+            t_s = timed(jax.jit(
+                lambda c, u=u: scan_loop(touch, c, steps, u)), c0)
+            row.append(f"scan/u{u} {t_s / steps * 1e6:8.2f}")
+        print(f"{name:14s} " + "  ".join(row) + "  us/step", flush=True)
+
+
+def make_sweep_step(lanes, Q, N=16, G=8, F=8):
+    """Composite body shaped like the planned scatter-free engine step."""
+    key = jax.random.PRNGKey(0)
+    pod_feat = jax.random.randint(key, (Q, 8), 1, 1000, dtype=jnp.int32)
+    w = jax.random.normal(key, (lanes, F), jnp.float32)
+    n_iota = jnp.arange(N, dtype=jnp.int32)
+    g_iota = jnp.arange(G, dtype=jnp.uint32)
+    q_iota = jnp.arange(Q, dtype=jnp.int32)
+
+    def body(i, c):
+        ev_t, aux, cpu, mem, gmil, hist = c
+        # 1. fused reduce pass: pop argmin + pending-delete min
+        s = jnp.argmin(ev_t, axis=-1).astype(jnp.int32)        # [L]
+        t = jnp.min(ev_t, axis=-1)                             # [L]
+        bdel = jnp.min(jnp.where(aux >= 0, ev_t, INF), axis=-1)
+        # 2. gather pod features + aux at popped slot
+        pf = pod_feat[s]                                       # [L,8]
+        aux_s = jnp.take_along_axis(aux, s[:, None], axis=-1)[:, 0]
+        is_del = aux_s >= 0
+        # 3. refunds: one-hot dense adds over node axes
+        a = jnp.where(is_del, aux_s >> 8, 0)
+        bits = (aux_s & 255).astype(jnp.uint32)
+        oh_a = (n_iota[None, :] == a[:, None]).astype(jnp.int32)
+        oh_a = oh_a * is_del.astype(jnp.int32)[:, None]
+        cpu = cpu + oh_a * pf[:, 0:1]
+        mem = mem + oh_a * pf[:, 1:2]
+        selb = ((bits[:, None] >> g_iota[None, :]) & 1).astype(jnp.int32)
+        gmil = gmil + oh_a[:, :, None] * (pf[:, 2:3, None] * selb[:, None, :])
+        # 4. policy: linear features over node state
+        feats = jnp.stack([
+            cpu.astype(jnp.float32), mem.astype(jnp.float32),
+            gmil.sum(-1).astype(jnp.float32),
+            (cpu - pf[:, 0:1]).astype(jnp.float32),
+            (mem - pf[:, 1:2]).astype(jnp.float32),
+            gmil.max(-1).astype(jnp.float32),
+            jnp.broadcast_to(t[:, None], cpu.shape).astype(jnp.float32),
+            jnp.broadcast_to(pf[:, 3:4], cpu.shape).astype(jnp.float32),
+        ], axis=-1)                                            # [L,N,F]
+        scores = jnp.einsum("lnf,lf->ln", feats, w)
+        wn = jnp.argmax(scores, axis=-1).astype(jnp.int32)     # [L]
+        placed = (~is_del) & (jnp.max(scores, axis=-1) > 0)
+        # 5. allocator: sort one gathered gpu row
+        grow = jnp.take_along_axis(
+            gmil, wn[:, None, None], axis=1)[:, 0, :]          # [L,G]
+        order = jnp.argsort(grow, axis=-1)
+        sel = order < pf[:, 4:5] % 3
+        nbits = jnp.sum(jnp.where(sel, jnp.uint32(1) << g_iota[None, :],
+                                  jnp.uint32(0)), axis=-1, dtype=jnp.uint32)
+        # 6. place: one-hot dense node updates
+        oh_w = (n_iota[None, :] == wn[:, None]).astype(jnp.int32)
+        oh_w = oh_w * placed.astype(jnp.int32)[:, None]
+        cpu = cpu - oh_w * pf[:, 0:1]
+        mem = mem - oh_w * pf[:, 1:2]
+        gmil = gmil - oh_w[:, :, None] * (pf[:, 2:3, None] * sel[:, None, :])
+        # 7. hist blend + frag reduce
+        hb = jnp.clip(pf[:, 5], 0, hist.shape[-1] - 1)
+        hist = hist + ((jnp.arange(hist.shape[-1])[None, :] == hb[:, None])
+                       & (~placed & ~is_del)[:, None]).astype(jnp.int32)
+        mn = jnp.argmax(hist > 0, axis=-1)
+        frag = jnp.sum(jnp.where((gmil > 0) & (gmil < mn[:, None, None]),
+                                 gmil, 0), axis=(1, 2))
+        # 8. slot blend: one fused pass writing ev_t + aux
+        newt = jnp.where(placed, t + pf[:, 6], INF)
+        newa = jnp.where(placed, (wn << 8) | nbits.astype(jnp.int32), -1)
+        m = q_iota[None, :] == s[:, None]
+        ev_t = jnp.where(m, newt[:, None], ev_t)
+        aux = jnp.where(m, newa[:, None] + (frag[:, None] & 0), aux)
+        return (ev_t, aux, cpu, mem, gmil, hist)
+
+    def init():
+        kt = jax.random.randint(key, (lanes, Q), 1, 1 << 24, dtype=jnp.int32)
+        return (kt, jnp.full((lanes, Q), -1, jnp.int32),
+                jnp.full((lanes, N), 64000, jnp.int32),
+                jnp.full((lanes, N), 256000, jnp.int32),
+                jnp.full((lanes, N, G), 1000, jnp.int32),
+                jnp.zeros((lanes, 1001), jnp.int32))
+
+    return body, init
+
+
+def part_c(steps):
+    print("== C: composite sweep-step prototype ==", flush=True)
+    for lanes in (64, 256):
+        body, init = make_sweep_step(lanes, 8192)
+        c0 = init()
+        t_w = timed(jax.jit(lambda c: while_loop(body, c, steps)), c0)
+        print(f"lanes={lanes:4d} while    {t_w / steps * 1e6:8.2f} us/step"
+              f"  -> {lanes / (t_w / steps * 32608):7.1f} evals/s proj",
+              flush=True)
+        for u in (4, 8):
+            t_s = timed(jax.jit(
+                lambda c, u=u: scan_loop(body, c, steps, u)), c0)
+            print(f"lanes={lanes:4d} scan/u{u}  {t_s / steps * 1e6:8.2f}"
+                  f" us/step  -> {lanes / (t_s / steps * 32608):7.1f}"
+                  " evals/s proj", flush=True)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=2048)
+    ap.add_argument("--parts", type=str, default="abc")
+    args = ap.parse_args()
+    dev = jax.devices()[0]
+    print(f"device: {dev.platform} ({dev.device_kind}); steps={args.steps}",
+          file=sys.stderr)
+    if "a" in args.parts or "b" in args.parts:
+        part_a_b(args.steps)
+    if "c" in args.parts:
+        part_c(args.steps)
+
+
+if __name__ == "__main__":
+    main()

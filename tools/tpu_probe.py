@@ -18,7 +18,10 @@ def log(*a):
 
 
 def main():
-    pops = [int(x) for x in sys.argv[1:]] or [8, 32]
+    args = [a for a in sys.argv[1:] if not a.startswith("--")]
+    pop_only = "--pop-only" in sys.argv
+    ctime = "--ctime" in sys.argv
+    pops = [int(x) for x in args] or [8, 32]
     dev = jax.devices()[0]
     log(f"device: {dev.platform} ({dev.device_kind})")
 
@@ -30,6 +33,10 @@ def main():
 
     wl = TraceParser().parse_workload()
     log(f"workload: {wl.num_nodes} nodes x {wl.num_pods} pods")
+
+    if pop_only:
+        _pop_stage(wl, pops, ctime)
+        return
 
     # stage 1: exact engine single run (the parity-gate unit)
     t0 = time.perf_counter()
@@ -58,7 +65,15 @@ def main():
         f" us/event ({ev_n} events)")
 
     # stage 3: flat population chunks (same capped step budget as bench.py)
-    cfg = SimConfig(max_steps=4 * wl.num_pods)
+    _pop_stage(wl, pops, ctime)
+
+
+def _pop_stage(wl, pops, ctime):
+    from fks_tpu.models import parametric
+    from fks_tpu.parallel import make_population_eval
+    from fks_tpu.sim.engine import SimConfig
+
+    cfg = SimConfig(max_steps=4 * wl.num_pods, track_ctime=ctime)
     for pop in pops:
         key = jax.random.PRNGKey(0)
         params = parametric.init_population(key, pop, noise=0.1)
