@@ -62,7 +62,7 @@ def _fail(error: str) -> int:
     return 1
 
 
-def _probe_backend(budget_s: int, attempts: int = 3):
+def _probe_backend(budget_s: int):
     """The axon TPU tunnel can WEDGE (hang indefinitely) after a killed
     device execution; backend init then blocks forever. Probe device
     discovery in a subprocess so a wedged tunnel yields an error JSON
@@ -73,24 +73,25 @@ def _probe_backend(budget_s: int, attempts: int = 3):
     Returns None when healthy, else an error string."""
     deadline = time.monotonic() + budget_s
     last = None
-    for i in range(attempts):
+    attempt = 0
+    while True:
         remaining = deadline - time.monotonic()
         if remaining < 10:
             break
+        attempt += 1
         try:
             r = subprocess.run(
                 [sys.executable, "-c", "import jax; jax.devices()"],
                 timeout=min(120, remaining), capture_output=True, text=True)
         except subprocess.TimeoutExpired:
             last = "device backend initialization timed out (wedged tunnel?)"
-            log(f"backend probe attempt {i + 1}/{attempts}: {last}")
+            log(f"backend probe attempt {attempt}: {last}")
             continue
         if r.returncode != 0:
             last = f"device backend initialization failed (rc={r.returncode})"
-            log(f"backend probe attempt {i + 1}/{attempts} rc={r.returncode}:"
+            log(f"backend probe attempt {attempt} rc={r.returncode}:"
                 f"\n{r.stderr[-2000:]}")
-            if i + 1 < attempts:
-                time.sleep(max(0, min(30, deadline - time.monotonic())))
+            time.sleep(max(0, min(30, deadline - time.monotonic())))
             continue
         return None
     return last

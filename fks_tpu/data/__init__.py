@@ -1,4 +1,4 @@
 from fks_tpu.data.entities import ClusterArrays, PodArrays, Workload
-from fks_tpu.data.traces import TraceParser, DEFAULT_TRACES_DIR
+from fks_tpu.data.traces import TraceParser, default_traces_dir
 
-__all__ = ["ClusterArrays", "PodArrays", "Workload", "TraceParser", "DEFAULT_TRACES_DIR"]
+__all__ = ["ClusterArrays", "PodArrays", "Workload", "TraceParser", "default_traces_dir"]

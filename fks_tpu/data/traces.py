@@ -35,17 +35,16 @@ import numpy as np
 
 from fks_tpu.data.entities import ClusterArrays, PodArrays, Workload
 
-def _default_traces_dir() -> Path:
+def default_traces_dir() -> Path:
     """benchmarks/traces next to the package root (source checkout), falling
     back to the current working directory (the dataset is repo data, not
-    package data -- an installed wheel must point at a checkout or cwd)."""
+    package data -- an installed wheel must point at a checkout or cwd).
+    Resolved at CALL time, so an installed package picks up the caller's
+    cwd rather than freezing whatever cwd the first import happened in."""
     checkout = Path(__file__).resolve().parent.parent.parent / "benchmarks" / "traces"
     if checkout.is_dir():
         return checkout
     return Path.cwd() / "benchmarks" / "traces"
-
-
-DEFAULT_TRACES_DIR = _default_traces_dir()
 
 GPU_MILLI_CAPACITY = 1000  # per-GPU compute capacity (reference: parser.py:45-46)
 
@@ -72,8 +71,9 @@ class TraceParser:
     discovery helpers.
     """
 
-    def __init__(self, traces_dir: str | Path = DEFAULT_TRACES_DIR):
-        self.traces_dir = Path(traces_dir)
+    def __init__(self, traces_dir: str | Path | None = None):
+        self.traces_dir = Path(traces_dir) if traces_dir is not None \
+            else default_traces_dir()
         self.csv_dir = self.traces_dir / "csv"
         self.gpu_mem_mapping = self._load_gpu_memory_mapping()
 

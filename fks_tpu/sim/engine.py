@@ -124,6 +124,12 @@ def initial_state(workload: Workload, cfg: SimConfig) -> SimState:
     )
 
 
+def _widest_int():
+    """Accumulation dtype for cluster-wide integer sums: int64 when x64 is
+    enabled, else int32 (on by default on TPU, where 64-bit is emulated)."""
+    return jnp.int64 if jax.config.jax_enable_x64 else jnp.int32
+
+
 def _node_view(c: ClusterArrays, cpu_left, mem_left, gpu_left, gpu_milli_left):
     return NodeView(
         cpu_milli_left=cpu_left, cpu_milli_total=c.cpu_total,
@@ -255,7 +261,7 @@ def build_step(workload: Workload, policy: PolicyFn, cfg: SimConfig,
             gpu_milli_left, 0)
         frag_score = jnp.where(
             has_gpu_waiting & (total_gm > 0),
-            jnp.sum(frag_free, dtype=jnp.int64 if jnp.int64 == jnp.asarray(0).dtype else jnp.int32).astype(f)
+            jnp.sum(frag_free, dtype=_widest_int()).astype(f)
             / jnp.maximum(total_gm, 1).astype(f),
             jnp.asarray(0, f))
         frag_sum = s.frag_sum + jnp.where(failp, frag_score, 0)

@@ -77,8 +77,8 @@ import numpy as np
 from fks_tpu.data.entities import Workload
 from fks_tpu.ops.allocator import best_fit_gpus, first_fit_gpus
 from fks_tpu.sim.engine import (
-    SimConfig, _audit, _node_view, finalize_fields, loop_tables,
-    run_batched_lanes,
+    SimConfig, _audit, _node_view, _widest_int, finalize_fields,
+    loop_tables, run_batched_lanes,
 )
 from fks_tpu.sim.types import FlatState, PodView, PolicyFn, SimResult
 
@@ -284,7 +284,7 @@ def build_step(workload: Workload, policy: PolicyFn, cfg: SimConfig,
             gpu_milli_left, 0)
         frag_score = jnp.where(
             has_gpu_waiting & (total_gm > 0),
-            jnp.sum(frag_free, dtype=jnp.int32).astype(f)
+            jnp.sum(frag_free, dtype=_widest_int()).astype(f)
             / jnp.maximum(total_gm, 1).astype(f),
             jnp.asarray(0, f))
         frag_sum = s.frag_sum + jnp.where(failp, frag_score, 0)

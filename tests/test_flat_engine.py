@@ -158,3 +158,58 @@ def test_invariant_audit_clean(default_workload):
     cfg = SimConfig(validate_invariants=True)
     res = flat.simulate(default_workload, zoo.ZOO["best_fit"](), cfg)
     assert int(res.invariant_violations) == 0
+
+
+def test_unpacked_aux_gpus_path_bit_identical():
+    """When node_bits + G > 31 the (node, gpu_bits) pair no longer fits one
+    int32 aux word and the engine must fall back to a separate aux_gpus
+    carry (fks_tpu/sim/flat.py _packable). Same contract as the packed
+    path: bit-identical to the exact engine on retry-free runs."""
+    rng = np.random.default_rng(3)
+    nodes = [{"node_id": f"n{i}", "cpu_milli": 64000, "memory_mib": 262144,
+              "gpus": [1000] * 30, "gpu_memory_mib": 16384} for i in range(4)]
+    pods = [{"pod_id": f"pod-{i:04d}",
+             "cpu_milli": int(rng.integers(100, 1500)),
+             "memory_mib": int(rng.integers(100, 4000)),
+             "num_gpu": int(rng.integers(0, 5)),
+             "gpu_milli": int(rng.integers(1, 400)),
+             "creation_time": int(rng.integers(0, 1000)),
+             "duration_time": int(rng.integers(0, 500))}
+            for i in range(32)]
+    for p in pods:
+        if p["num_gpu"] == 0:
+            p["gpu_milli"] = 0
+    wl = make_workload(nodes, pods, pad_nodes_to=4, pad_gpus_to=30,
+                       pad_pods_to=32)
+    cfg = SimConfig()
+    assert not flat._packable(wl.cluster.n_padded, wl.cluster.g_padded)
+    assert flat.initial_state(wl, cfg).aux_gpus is not None
+    for name in ("first_fit", "best_fit"):
+        exact = simulate(wl, zoo.ZOO[name](), cfg)
+        fastr = flat.simulate(wl, zoo.ZOO[name](), cfg)
+        assert int(exact.num_fragmentation_events) == 0
+        _assert_results_equal(exact, fastr)
+
+
+def test_unpacked_aux_gpus_with_contention():
+    """Unpacked path under GPU contention (failed placements + retries +
+    delete refunds through the separate gpu-bits carry): observables must
+    stay internally consistent and the run must complete."""
+    nodes = [{"node_id": "n0", "cpu_milli": 64000, "memory_mib": 262144,
+              "gpus": [1000] * 30, "gpu_memory_mib": 16384}]
+    # 6 pods each wanting 12 of 30 GPUs: at most 2 fit concurrently
+    pods = [{"pod_id": f"pod-{i:02d}", "cpu_milli": 100, "memory_mib": 100,
+             "num_gpu": 12, "gpu_milli": 900, "creation_time": i,
+             "duration_time": 50} for i in range(6)]
+    # pad the node axis to 4 so node_bits(2) + G(30) > 31 -> unpacked
+    wl = make_workload(nodes, pods, pad_nodes_to=4, pad_gpus_to=30,
+                       pad_pods_to=8)
+    assert not flat._packable(wl.cluster.n_padded, wl.cluster.g_padded)
+    res = flat.simulate(wl, zoo.ZOO["best_fit"](),
+                        SimConfig(validate_invariants=True))
+    assert int(res.invariant_violations) == 0
+    assert int(res.scheduled_pods) == 6
+    assert not bool(res.failed)
+    # every assigned pod holds exactly num_gpu distinct GPUs
+    bits = np.asarray(res.assigned_gpus)[:6]
+    assert all(bin(int(b)).count("1") == 12 for b in bits)
