@@ -35,7 +35,8 @@ executions over ~60 s and can leave the device wedged afterwards):
 Env knobs: FKS_BENCH_POP (total population, default 512),
 FKS_BENCH_CHUNK (per-device-call lanes, default 256),
 FKS_BENCH_REPS (timed repetitions, default 2),
-FKS_BENCH_ENGINE (flat|exact, default flat),
+FKS_BENCH_ENGINE (flat|exact|fused, default flat; "fused" = the Pallas
+whole-loop-in-VMEM kernel, fks_tpu/sim/fused.py),
 FKS_BENCH_DEADLINE_S (controller budget for ALL stages, default 2400).
 Stages run as ``python bench.py --stage parity|throughput`` (argv, not env,
 so a leaked variable can't turn the top-level run into a bare stage).
@@ -118,7 +119,7 @@ def stage_parity(engine: str) -> int:
             log(f"PARITY FAIL {name}: got {got:.6f} want {want:.4f}")
             return 1
         log(f"parity ok {name}: {got:.4f}")
-    if engine == "flat":
+    if engine in ("flat", "fused"):  # fused shares the flat semantics
         got = float(flat.simulate(wl, zoo.ZOO["best_fit"]()).policy_score)
         if abs(got - PARITY["best_fit"]) > 2e-2:
             log(f"FLAT SANITY FAIL best_fit: {got:.4f}")
@@ -151,7 +152,11 @@ def stage_throughput(pop: int, chunk: int, reps: int, engine: str) -> int:
     cfg = SimConfig(max_steps=4 * wl.num_pods, track_ctime=False)
     key = jax.random.PRNGKey(0)
     params = parametric.init_population(key, pop, noise=0.1)
-    ev = make_population_eval(wl, cfg=cfg, engine=engine)
+    if engine == "fused":
+        from fks_tpu.sim import fused
+        ev = fused.make_fused_population_run(wl, cfg, lanes=min(64, chunk))
+    else:
+        ev = make_population_eval(wl, cfg=cfg, engine=engine)
 
     t0 = time.perf_counter()
     res = ev(params[:chunk])
@@ -161,6 +166,26 @@ def stage_throughput(pop: int, chunk: int, reps: int, engine: str) -> int:
     log(f"first chunk (compile+run): {t_compile:.1f}s; scores "
         f"[{float(np.min(res.policy_score)):.3f}, "
         f"{float(np.max(res.policy_score)):.3f}]; truncated {n_trunc}/{chunk}")
+
+    if engine == "fused":
+        # the CPU parity gate never executes Mosaic-compiled code, so gate
+        # the fused kernel here: a small same-device population must match
+        # the XLA flat engine (exact trajectories; f32 accumulators to ulp)
+        ncheck = min(8, chunk)
+        ref = make_population_eval(wl, cfg=cfg, engine="flat")(
+            params[:ncheck])
+        got = ev(params[:ncheck])
+        if not np.array_equal(np.asarray(got.scheduled_pods),
+                              np.asarray(ref.scheduled_pods)) or \
+           not np.allclose(np.asarray(got.policy_score),
+                           np.asarray(ref.policy_score),
+                           rtol=2e-5, atol=2e-5):
+            log(f"FUSED GATE FAIL: fused {np.asarray(got.policy_score)} "
+                f"vs flat {np.asarray(ref.policy_score)}; scheduled "
+                f"{np.asarray(got.scheduled_pods)} vs "
+                f"{np.asarray(ref.scheduled_pods)}")
+            return 1
+        log(f"fused-vs-flat device gate ok ({ncheck} candidates)")
 
     # chunks must share the compiled program: slice then pad the tail to
     # the chunk width instead of re-jitting a smaller batch. Built once,

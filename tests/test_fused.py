@@ -1,0 +1,133 @@
+"""Fused Pallas kernel (fks_tpu/sim/fused.py) vs the XLA flat engine.
+
+Contract: for the same parametric population the fused kernel reproduces
+the flat engine's trajectory EXACTLY on every integer observable
+(placements, GPU picks, event/snapshot/fragmentation counts, final node
+remnants, truncation/failure flags). Float accumulators (utilization
+sums, fragmentation mean, policy score) may differ by a few ulp because
+the two programs compile the same f32 arithmetic separately.
+
+CPU runs use interpret mode, so workloads here are small; the TPU bench
+path exercises the compiled kernel on the full default trace
+(tools/tpu_probe.py --fused).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fks_tpu.data.build import make_workload
+from fks_tpu.models import parametric
+from fks_tpu.sim import flat, fused
+from fks_tpu.sim.engine import SimConfig
+
+INT_FIELDS = (
+    "events_processed", "scheduled_pods", "num_snapshots",
+    "num_fragmentation_events", "assigned_node", "assigned_gpus",
+    "cpu_left", "mem_left", "gpu_left", "gpu_milli_left", "max_nodes",
+    "truncated", "failed", "invariant_violations",
+)
+FLOAT_FIELDS = (
+    "policy_score", "avg_cpu_utilization", "avg_memory_utilization",
+    "avg_gpu_count_utilization", "avg_gpu_memory_utilization",
+    "gpu_fragmentation_score",
+)
+
+
+def _assert_matches(res, ref):
+    for f in INT_FIELDS:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(res, f)), np.asarray(getattr(ref, f)),
+            err_msg=f)
+    for f in FLOAT_FIELDS:
+        np.testing.assert_allclose(
+            np.asarray(getattr(res, f)), np.asarray(getattr(ref, f)),
+            rtol=2e-6, atol=2e-6, err_msg=f)
+
+
+def _run_both(wl, cfg, params, lanes=8):
+    run = fused.make_fused_population_run(wl, cfg, lanes=lanes,
+                                          interpret=True)
+    res = run(params)
+    pop = flat.make_population_run_fn(wl, parametric.score, cfg)
+    ref = pop(params, flat.initial_state(wl, cfg))
+    return res, ref
+
+
+def _roomy():
+    rng = np.random.default_rng(11)
+    nodes = [{"node_id": f"n{i}", "cpu_milli": 64000, "memory_mib": 262144,
+              "gpus": [1000] * 8, "gpu_memory_mib": 16384} for i in range(4)]
+    pods = [{"pod_id": f"pod-{i:04d}",
+             "cpu_milli": int(rng.integers(100, 1500)),
+             "memory_mib": int(rng.integers(100, 4000)),
+             "num_gpu": int(rng.integers(0, 3)),
+             "gpu_milli": int(rng.integers(1, 300)),
+             "creation_time": int(rng.integers(0, 1000)),
+             "duration_time": int(rng.integers(0, 500))}
+            for i in range(48)]
+    for p in pods:
+        if p["num_gpu"] == 0:
+            p["gpu_milli"] = 0
+    return make_workload(nodes, pods, pad_nodes_to=4, pad_gpus_to=8,
+                         pad_pods_to=64)
+
+
+def _contended():
+    rng = np.random.default_rng(7)
+    nodes = [{"node_id": f"n{i}", "cpu_milli": 16000, "memory_mib": 32000,
+              "gpus": [1000] * 2, "gpu_memory_mib": 8000} for i in range(4)]
+    pods = [{"pod_id": f"pod-{i:04d}",
+             "cpu_milli": int(rng.integers(500, 6000)),
+             "memory_mib": int(rng.integers(500, 12000)),
+             "num_gpu": int(rng.integers(0, 3)),
+             "gpu_milli": int(rng.integers(100, 1000)),
+             "creation_time": int(rng.integers(0, 300)),
+             "duration_time": int(rng.integers(10, 200))}
+            for i in range(96)]
+    for p in pods:
+        if p["num_gpu"] == 0:
+            p["gpu_milli"] = 0
+    return make_workload(nodes, pods, pad_nodes_to=4, pad_gpus_to=2,
+                         pad_pods_to=128)
+
+
+def test_roomy_population_matches_flat():
+    wl = _roomy()
+    cfg = SimConfig(track_ctime=False)
+    params = parametric.init_population(jax.random.PRNGKey(0), 8, noise=0.2)
+    res, ref = _run_both(wl, cfg, params)
+    assert int(np.asarray(ref.truncated).sum()) == 0
+    _assert_matches(res, ref)
+
+
+def test_contended_population_matches_flat():
+    """Retries, fragmentation events, silent drops, step-budget truncation
+    — the full set of failure paths — must match event for event."""
+    wl = _contended()
+    cfg = SimConfig(track_ctime=False, max_steps=4 * 96)
+    params = parametric.init_population(jax.random.PRNGKey(3), 8, noise=0.5)
+    res, ref = _run_both(wl, cfg, params)
+    assert int(np.asarray(ref.num_fragmentation_events).sum()) > 0
+    _assert_matches(res, ref)
+
+
+def test_population_padding_to_lane_multiple():
+    """pop not a multiple of lanes: results for the real candidates are
+    unchanged by the padding rows."""
+    wl = _roomy()
+    cfg = SimConfig(track_ctime=False)
+    params = parametric.init_population(jax.random.PRNGKey(1), 5, noise=0.2)
+    res, ref = _run_both(wl, cfg, params, lanes=8)
+    assert np.asarray(res.policy_score).shape == (5,)
+    _assert_matches(res, ref)
+
+
+def test_builder_rejects_unsupported_configs():
+    wl = _roomy()
+    with pytest.raises(ValueError, match="best_fit"):
+        fused.make_fused_population_run(
+            wl, SimConfig(gpu_allocator="first_fit"))
+    with pytest.raises(ValueError, match="audit"):
+        fused.make_fused_population_run(
+            wl, SimConfig(validate_invariants=True))

@@ -21,6 +21,7 @@ def main():
     args = [a for a in sys.argv[1:] if not a.startswith("--")]
     pop_only = "--pop-only" in sys.argv
     ctime = "--ctime" in sys.argv
+    fused = "--fused" in sys.argv
     pops = [int(x) for x in args] or [8, 32]
     dev = jax.devices()[0]
     log(f"device: {dev.platform} ({dev.device_kind})")
@@ -35,7 +36,7 @@ def main():
     log(f"workload: {wl.num_nodes} nodes x {wl.num_pods} pods")
 
     if pop_only:
-        _pop_stage(wl, pops, ctime)
+        _pop_stage(wl, pops, ctime, fused)
         return
 
     # stage 1: exact engine single run (the parity-gate unit)
@@ -65,10 +66,10 @@ def main():
         f" us/event ({ev_n} events)")
 
     # stage 3: flat population chunks (same capped step budget as bench.py)
-    _pop_stage(wl, pops, ctime)
+    _pop_stage(wl, pops, ctime, fused)
 
 
-def _pop_stage(wl, pops, ctime):
+def _pop_stage(wl, pops, ctime, fused=False):
     from fks_tpu.models import parametric
     from fks_tpu.parallel import make_population_eval
     from fks_tpu.sim.engine import SimConfig
@@ -77,7 +78,12 @@ def _pop_stage(wl, pops, ctime):
     for pop in pops:
         key = jax.random.PRNGKey(0)
         params = parametric.init_population(key, pop, noise=0.1)
-        ev = make_population_eval(wl, cfg=cfg, engine="flat")
+        if fused:
+            from fks_tpu.sim import fused as fused_mod
+            ev = fused_mod.make_fused_population_run(
+                wl, cfg, lanes=min(64, pop))
+        else:
+            ev = make_population_eval(wl, cfg=cfg, engine="flat")
         t0 = time.perf_counter()
         res = ev(params)
         jax.block_until_ready(res.policy_score)
