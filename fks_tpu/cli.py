@@ -290,11 +290,14 @@ def main(argv=None) -> int:
                         help="force the CPU backend (skip the TPU tunnel)")
     common.add_argument("--metrics", default="",
                         help="append JSONL metric records to this file")
-    common.add_argument("--engine", choices=("exact", "flat"), default="exact",
+    common.add_argument("--engine", choices=("exact", "flat", "fused"),
+                        default="exact",
                         help="simulation engine: 'exact' replicates the "
                              "reference bit-for-bit; 'flat' is the TPU "
                              "throughput engine (documented retry-rule "
-                             "divergence, fks_tpu.sim.flat)")
+                             "divergence, fks_tpu.sim.flat); 'fused' is the "
+                             "Pallas whole-loop-in-VMEM kernel (parametric "
+                             "populations — 'scale' command only)")
 
     b = sub.add_parser("bench", help="policy comparison table", parents=[common])
     _add_trace_flags(b)
@@ -335,6 +338,11 @@ def main(argv=None) -> int:
     t.set_defaults(fn=cmd_traces)
 
     args = ap.parse_args(argv)
+    if getattr(args, "engine", "exact") == "fused" and args.cmd != "scale":
+        ap.error("--engine fused evaluates parametric populations only — "
+                 "it applies to the 'scale' command (other commands run "
+                 "single policies or arbitrary evolved code; use "
+                 "'exact'/'flat' there)")
     return args.fn(args)
 
 

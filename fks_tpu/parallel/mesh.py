@@ -185,6 +185,10 @@ def _top_k_real(global_scores, real_count, k):
 
 def _engine_runner(workload, param_policy, cfg, engine):
     """(population run fn, initial state) for the chosen engine."""
+    if engine == "fused":
+        from fks_tpu.parallel.population import fused_runner
+        frun = fused_runner(workload, param_policy, cfg)
+        return (lambda params, _state0: frun(params)), None
     from fks_tpu.sim import get_engine
     mod = get_engine(engine)
     return (mod.make_population_run_fn(workload, param_policy, cfg),

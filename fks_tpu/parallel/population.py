@@ -39,6 +39,19 @@ ParamPolicyFn = Callable[[jax.Array, PodView, NodeView], jax.Array]
 make_single_run = make_param_run_fn
 
 
+def fused_runner(workload: Workload, param_policy, cfg: SimConfig):
+    """The ONE dispatch point for the fused Pallas engine (shared by the
+    vmap path here and the shard_map path in fks_tpu.parallel.mesh, so the
+    fused contract cannot drift between them). The kernel hard-wires the
+    parametric feature basis, so any other policy is rejected."""
+    if param_policy is not parametric.score:
+        raise ValueError("engine='fused' hard-wires the parametric feature "
+                         "basis; pass param_policy=parametric.score or use "
+                         "engine='flat'")
+    from fks_tpu.sim import fused
+    return fused.make_fused_population_run(workload, cfg)
+
+
 def make_population_eval(workload: Workload,
                          param_policy: ParamPolicyFn = parametric.score,
                          cfg: SimConfig = SimConfig(),
@@ -54,8 +67,17 @@ def make_population_eval(workload: Workload,
     ``engine``: "exact" replicates the reference bit-for-bit (heap replica,
     layout-dependent retry rule); "flat" is the TPU throughput engine
     (fks_tpu.sim.flat — identical semantics except the documented
-    retry-time rule; ~an order of magnitude faster per step on TPU).
+    retry-time rule; ~an order of magnitude faster per step on TPU);
+    "fused" is the Pallas whole-loop-in-VMEM kernel (fks_tpu.sim.fused —
+    flat semantics, parametric policies ONLY: ``param_policy`` must be
+    the default ``parametric.score``).
     """
+    if engine == "fused":
+        run = fused_runner(workload, param_policy, cfg)
+        # jit covers run()'s XLA-side pre/post work (padding, aux decode,
+        # finalize) around the pallas_call
+        return jax.jit(run) if jit else run
+
     from fks_tpu.sim import get_engine
     mod = get_engine(engine)
     run = mod.make_population_run_fn(workload, param_policy, cfg)

@@ -25,4 +25,11 @@ def get_engine(name: str):
     if name == "flat":
         from fks_tpu.sim import flat
         return flat
+    if name == "fused":
+        raise ValueError(
+            "the fused Pallas kernel is not a general engine module — it "
+            "hard-wires the parametric policy and has no single-policy "
+            "surface. Use parallel.make_population_eval(engine='fused') "
+            "(or fks_tpu.sim.fused directly) for parametric populations; "
+            "'exact'/'flat' elsewhere.")
     raise ValueError(f"unknown engine {name!r}; expected 'exact' or 'flat'")

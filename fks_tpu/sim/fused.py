@@ -72,7 +72,6 @@ def _round_up(x: int, m: int) -> int:
 class _Plan(NamedTuple):
     """Static geometry + host-prepared constants for the kernel."""
 
-    lanes: int
     q: int            # slot count (p_padded rounded up to 128)
     n: int
     g: int
@@ -92,7 +91,7 @@ class _Plan(NamedTuple):
     totals: tuple     # (total_cpu, total_mem, total_gc, total_gm) python ints
 
 
-def _build_plan(workload: Workload, cfg: SimConfig, lanes: int) -> _Plan:
+def _build_plan(workload: Workload, cfg: SimConfig) -> _Plan:
     c, p = workload.cluster, workload.pods
     n, g, pp = c.n_padded, c.g_padded, p.p_padded
     if not _packable(n, g):
@@ -144,7 +143,7 @@ def _build_plan(workload: Workload, cfg: SimConfig, lanes: int) -> _Plan:
     totals = (int(nrow[0].sum()), int(nrow[1].sum()),
               int(nrow[3].sum()), int(milli_tot.sum()))
     return _Plan(
-        lanes=lanes, q=q, n=n, g=g, hist=hist, klen=ktable.shape[1],
+        q=q, n=n, g=g, hist=hist, klen=ktable.shape[1],
         max_steps=int(max_steps), pending0=int(pm.sum()),
         node_bits=max(1, (max(n, 1) - 1).bit_length()),
         ev0=jnp.asarray(ev0)[None, :], feat_f=jnp.asarray(feat),
@@ -153,7 +152,7 @@ def _build_plan(workload: Workload, cfg: SimConfig, lanes: int) -> _Plan:
     )
 
 
-def _kernel(plan: _Plan,
+def _kernel(plan: _Plan, lanes: int,
             # inputs
             params_ref, ev0_ref, feat_ref, ktable_ref, nrow_ref, gmt_ref,
             gmask_ref,
@@ -161,7 +160,7 @@ def _kernel(plan: _Plan,
             aux_out, cpu_out, mem_out, gpu_out, gmil_out, acci_out, accf_out,
             # scratch
             ev, aux, cpu, mem, gpu, gmil, hist, acci, accf):
-    L, Q, N, G = plan.lanes, plan.q, plan.n, plan.g
+    L, Q, N, G = lanes, plan.q, plan.n, plan.g
     H, K = plan.hist, plan.klen
     t_cpu, t_mem, t_gc, t_gm = plan.totals
     f32 = jnp.float32
@@ -406,26 +405,31 @@ def _kernel(plan: _Plan,
 def make_fused_population_run(workload: Workload,
                               cfg: SimConfig = SimConfig(),
                               lanes: int = 64,
-                              interpret: bool = False):
+                              interpret: bool | None = None):
     """``run(params[P, F]) -> SimResult`` (leading axis P) through the fused
     kernel. P is padded up to a multiple of ``lanes``; each chunk of
-    ``lanes`` candidates is one grid step."""
-    plan = _build_plan(workload, cfg, lanes)
-    L, Q, N, G = plan.lanes, plan.q, plan.n, plan.g
+    ``lanes`` candidates is one grid step.
+
+    ``interpret=None`` (default) auto-selects: Mosaic-compile on TPU,
+    pallas interpreter elsewhere (slow — CPU callers should prefer
+    engine="exact"; the interpreter exists for correctness tests)."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    plan = _build_plan(workload, cfg)
+    Q, N, G = plan.q, plan.n, plan.g
     p = workload.pods
     pp = p.p_padded
 
-    kern = functools.partial(_kernel, plan)
     shared = lambda *shape: pl.BlockSpec(  # noqa: E731
         shape, lambda i: tuple(0 for _ in shape), memory_space=pltpu.VMEM)
     blocked = lambda *shape: pl.BlockSpec(  # noqa: E731
         shape, lambda i: (i,) + tuple(0 for _ in shape[1:]),
         memory_space=pltpu.VMEM)
 
-    def call(params_padded):
+    def call(params_padded, L):
         chunks = params_padded.shape[0] // L
         return pl.pallas_call(
-            kern,
+            functools.partial(_kernel, plan, L),
             grid=(chunks,),
             in_specs=[
                 blocked(L, NUM_FEATURES),
@@ -466,13 +470,16 @@ def make_fused_population_run(workload: Workload,
 
     def run(params) -> SimResult:
         pop = params.shape[0]
+        # lane width: the cap, or the whole (8-aligned) population when
+        # smaller — small shard sizes under shard_map stay cheap
+        L = min(lanes, _round_up(pop, 8))
         padded = _round_up(pop, L)
         if padded != pop:
             params = jnp.concatenate(
                 [params, jnp.broadcast_to(params[:1],
                                           (padded - pop,) + params.shape[1:])])
         aux, cpu, mem, gpu, gmil, acci, accf = call(
-            jnp.asarray(params, jnp.float32))
+            jnp.asarray(params, jnp.float32), L)
         aux = aux[:pop, :pp]
         an, ag = jax.vmap(
             lambda a: _decode_assignment(a, None, G, True))(aux)

@@ -131,3 +131,42 @@ def test_builder_rejects_unsupported_configs():
     with pytest.raises(ValueError, match="audit"):
         fused.make_fused_population_run(
             wl, SimConfig(validate_invariants=True))
+
+
+def test_fused_under_shard_map_matches_flat():
+    """The pallas_call composes with shard_map over the population mesh:
+    per-shard fused chunks + ICI all-gather elite selection must agree
+    with the sharded flat engine."""
+    from fks_tpu.parallel import make_sharded_eval, population_mesh
+
+    devices = jax.devices()
+    if len(devices) < 2:
+        pytest.skip("needs the multi-device CPU mesh")
+    wl = _roomy()
+    cfg = SimConfig(track_ctime=False)
+    mesh = population_mesh(devices)
+    pop = parametric.init_population(jax.random.PRNGKey(2),
+                                     2 * len(devices), noise=0.3)
+    sf, idxf, esf = make_sharded_eval(wl, mesh, cfg=cfg, elite_k=4,
+                                      engine="fused")(pop)
+    sl, idxl, esl = make_sharded_eval(wl, mesh, cfg=cfg, elite_k=4,
+                                      engine="flat")(pop)
+    np.testing.assert_allclose(np.asarray(sf), np.asarray(sl),
+                               rtol=2e-6, atol=2e-6)
+    np.testing.assert_array_equal(np.asarray(idxf), np.asarray(idxl))
+
+
+def test_unified_population_eval_fused_engine():
+    from fks_tpu.parallel import make_population_eval
+
+    wl = _roomy()
+    cfg = SimConfig(track_ctime=False)
+    params = parametric.init_population(jax.random.PRNGKey(4), 6, noise=0.2)
+    res = make_population_eval(wl, cfg=cfg, engine="fused")(params)
+    ref = make_population_eval(wl, cfg=cfg, engine="flat")(params)
+    np.testing.assert_allclose(np.asarray(res.policy_score),
+                               np.asarray(ref.policy_score),
+                               rtol=2e-6, atol=2e-6)
+    with pytest.raises(ValueError, match="parametric"):
+        make_population_eval(wl, param_policy=lambda p, a, b: 0,
+                             engine="fused")
