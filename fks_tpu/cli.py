@@ -237,6 +237,17 @@ def cmd_scale(args):
         pop = parametric.init_population(
             jax.random.PRNGKey(args.seed), args.pop, noise=0.1)
         cfg = SimConfig()
+        if args.engine == "fused":
+            # fail fast with actionable guidance when the synthetic shape
+            # exceeds the kernel's VMEM plan (the guard raises at build)
+            from fks_tpu.parallel.population import fused_runner
+            from fks_tpu.models.parametric import score as _pscore
+            try:
+                fused_runner(wl, _pscore, cfg)
+            except ValueError as e:
+                print(f"error: {e}\n(try smaller --nodes-count/"
+                      f"--pods-count, or --engine flat)", file=sys.stderr)
+                return 2
         devices = jax.devices()
         if len(devices) > 1:
             mesh = population_mesh(devices)

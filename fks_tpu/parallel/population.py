@@ -39,17 +39,21 @@ ParamPolicyFn = Callable[[jax.Array, PodView, NodeView], jax.Array]
 make_single_run = make_param_run_fn
 
 
-def fused_runner(workload: Workload, param_policy, cfg: SimConfig):
+def fused_runner(workload: Workload, param_policy, cfg: SimConfig,
+                 lanes: int = 64, interpret: bool | None = None):
     """The ONE dispatch point for the fused Pallas engine (shared by the
     vmap path here and the shard_map path in fks_tpu.parallel.mesh, so the
     fused contract cannot drift between them). The kernel hard-wires the
-    parametric feature basis, so any other policy is rejected."""
+    parametric feature basis, so any other policy is rejected. ``lanes``
+    caps the per-grid-step chunk (the kernel auto-shrinks it to the VMEM
+    budget); ``interpret=None`` auto-selects Mosaic on TPU."""
     if param_policy is not parametric.score:
         raise ValueError("engine='fused' hard-wires the parametric feature "
                          "basis; pass param_policy=parametric.score or use "
                          "engine='flat'")
     from fks_tpu.sim import fused
-    return fused.make_fused_population_run(workload, cfg)
+    return fused.make_fused_population_run(workload, cfg, lanes=lanes,
+                                           interpret=interpret)
 
 
 def make_population_eval(workload: Workload,

@@ -468,11 +468,27 @@ def make_fused_population_run(workload: Workload,
     inv = jnp.asarray(np.argsort(perm))
     ctime0 = jnp.asarray(p.creation_time, jnp.int32)
 
+    # VMEM feasibility: ~5 [L,q] i32 live arrays (ev, aux, blend mask +
+    # fusion temps), the tile-padded [L,n,128] grids, the [L,hist]
+    # waiting histogram, and slack for the small accumulators. Lanes
+    # auto-shrink to fit (~14 of the ~16 MB/core VMEM); shapes that
+    # cannot fit even 8 lanes are rejected up front instead of letting
+    # Mosaic fail opaquely — the XLA flat engine handles them.
+    per_lane_bytes = (5 * Q + 3 * N * 128 + plan.hist + 2048) * 4
+    lanes_fit = (14 * 2**20 // per_lane_bytes) // 8 * 8
+    if lanes_fit < 8:
+        raise ValueError(
+            f"workload too large for the fused kernel's VMEM plan "
+            f"({per_lane_bytes >> 10} KB/lane for q={Q}, n={N}, "
+            f"hist={plan.hist}; under 8 lanes fit); use the XLA flat "
+            "engine for large-node/pod shapes")
+
     def run(params) -> SimResult:
         pop = params.shape[0]
-        # lane width: the cap, or the whole (8-aligned) population when
-        # smaller — small shard sizes under shard_map stay cheap
-        L = min(lanes, _round_up(pop, 8))
+        # lane width: the cap, the whole (8-aligned) population when
+        # smaller — small shard sizes under shard_map stay cheap — or
+        # whatever VMEM can hold
+        L = min(lanes, _round_up(pop, 8), lanes_fit)
         padded = _round_up(pop, L)
         if padded != pop:
             params = jnp.concatenate(

@@ -170,3 +170,33 @@ def test_unified_population_eval_fused_engine():
     with pytest.raises(ValueError, match="parametric"):
         make_population_eval(wl, param_policy=lambda p, a, b: 0,
                              engine="fused")
+
+
+def test_vmem_guard_rejects_scale_shapes():
+    from fks_tpu.data.synthetic import synthetic_workload
+
+    wl = synthetic_workload(1000, 100_000, seed=0)
+    with pytest.raises(ValueError, match="VMEM"):
+        fused.make_fused_population_run(wl, SimConfig(track_ctime=False),
+                                        interpret=True)
+
+
+def test_sharded_generation_step_fused():
+    """device_evolution's training step (eval -> all-gather -> top-k ->
+    mutate) drives the fused engine end to end on the virtual mesh."""
+    from fks_tpu.parallel import make_sharded_generation_step, population_mesh
+
+    devices = jax.devices()
+    if len(devices) < 2:
+        pytest.skip("needs the multi-device CPU mesh")
+    wl = _roomy()
+    cfg = SimConfig(track_ctime=False)
+    mesh = population_mesh(devices)
+    pop = parametric.init_population(jax.random.PRNGKey(5),
+                                     2 * len(devices), noise=0.2)
+    step = make_sharded_generation_step(wl, mesh, cfg=cfg, elite_k=4,
+                                        engine="fused")
+    new_pop, scores, elite_scores = step(pop, jax.random.PRNGKey(6))
+    assert new_pop.shape == pop.shape
+    assert np.isfinite(np.asarray(scores)).all()
+    assert float(np.max(elite_scores)) >= float(np.min(scores))
