@@ -237,33 +237,31 @@ def cmd_scale(args):
         pop = parametric.init_population(
             jax.random.PRNGKey(args.seed), args.pop, noise=0.1)
         cfg = SimConfig()
-        if args.engine == "fused":
-            # fail fast with actionable guidance when the synthetic shape
-            # exceeds the kernel's VMEM plan (the guard raises at build)
-            from fks_tpu.parallel.population import fused_runner
-            from fks_tpu.models.parametric import score as _pscore
-            try:
-                fused_runner(wl, _pscore, cfg)
-            except ValueError as e:
-                print(f"error: {e}\n(try smaller --nodes-count/"
-                      f"--pods-count, or --engine flat)", file=sys.stderr)
-                return 2
         devices = jax.devices()
-        if len(devices) > 1:
-            mesh = population_mesh(devices)
-            padded, real = pad_population(pop, mesh)
-            ev = make_sharded_eval(wl, mesh, cfg=cfg,
-                                   elite_k=min(4, args.pop),
-                                   engine=args.engine)
-            with timed("eval") as t:
-                scores = t.sync(ev(padded, real)[0])[:real]
-            mode = f"sharded over {len(devices)} devices"
-        else:
-            evp = make_population_eval(wl, cfg=cfg, engine=args.engine)
-            with timed("eval") as t:
-                res = t.sync(evp(pop))
-            scores = res.policy_score
-            mode = "vmap on 1 device"
+        try:
+            if len(devices) > 1:
+                mesh = population_mesh(devices)
+                padded, real = pad_population(pop, mesh)
+                ev = make_sharded_eval(wl, mesh, cfg=cfg,
+                                       elite_k=min(4, args.pop),
+                                       engine=args.engine)
+                with timed("eval") as t:
+                    scores = t.sync(ev(padded, real)[0])[:real]
+                mode = f"sharded over {len(devices)} devices"
+            else:
+                evp = make_population_eval(wl, cfg=cfg, engine=args.engine)
+                with timed("eval") as t:
+                    res = t.sync(evp(pop))
+                scores = res.policy_score
+                mode = "vmap on 1 device"
+        except ValueError as e:
+            if args.engine != "fused":
+                raise
+            # the fused kernel's VMEM guard: fail with guidance, not a
+            # traceback (the shape fits the XLA engines)
+            print(f"error: {e}\n(try smaller --nodes-count/--pods-count, "
+                  f"or --engine flat)", file=sys.stderr)
+            return 2
         meter = ThroughputMeter()
         meter.add(args.pop, t.seconds)
         out = {

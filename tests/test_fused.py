@@ -200,3 +200,22 @@ def test_sharded_generation_step_fused():
     assert new_pop.shape == pop.shape
     assert np.isfinite(np.asarray(scores)).all()
     assert float(np.max(elite_scores)) >= float(np.min(scores))
+
+
+def test_parametric_evolution_on_fused_engine():
+    """ParametricEvolution (device-resident weight evolution) driving the
+    fused kernel for 2 generations improves-or-holds its best score."""
+    from fks_tpu.funsearch.device_evolution import ParametricEvolution
+
+    devices = jax.devices()
+    if len(devices) < 2:
+        pytest.skip("needs the multi-device CPU mesh")
+    pe = ParametricEvolution(_roomy(), pop_size=2 * len(devices),
+                             cfg=SimConfig(track_ctime=False),
+                             engine="fused", seed=1)
+    first = pe.run(1)
+    second = pe.run(1)
+    assert pe.generation == 2
+    assert second.best_score >= 0.0
+    assert pe.best_score >= first.best_score
+    assert "priority_function" in pe.best_code()

@@ -1,0 +1,200 @@
+"""Run the round's full TPU measurement session, wedge-safely.
+
+Each stage runs in its own subprocess with a timeout so one killed/wedged
+device call cannot take down the session; results append to a JSONL file
+(benchmarks/results/round2_tpu.jsonl by default) as they land. Stages:
+
+  probe     device aliveness + kind
+  flat      flat-engine population throughput (pop 256, ctime off)
+  fused64   fused-kernel population throughput, pop 64
+  fused256  fused-kernel population throughput, pop 256
+  gate      fused-vs-flat same-device parity gate (8 candidates)
+  tiers     measure_tiers (VM / jit / parametric / evolve-gen) on device
+  scale     synthetic 1000x20000 single-chip flat-engine run
+
+Usage: python -u tools/tpu_session.py [stage ...]   (default: all)
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OUT = os.path.join(REPO, "benchmarks", "results", "round2_tpu.jsonl")
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def record(obj):
+    obj = {"ts": round(time.time(), 1), **obj}
+    with open(OUT, "a") as f:
+        f.write(json.dumps(obj) + "\n")
+    print(json.dumps(obj), flush=True)
+
+
+def run_stage(name, code, timeout_s):
+    t0 = time.time()
+    # start_new_session so a timeout kills the WHOLE process group —
+    # otherwise grandchildren (the tiers stage's measure_tiers child)
+    # would survive and keep the device wedged
+    import signal
+    proc = subprocess.Popen([sys.executable, "-u", "-c", code],
+                            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                            text=True, cwd=REPO, start_new_session=True)
+    try:
+        out, err = proc.communicate(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except ProcessLookupError:
+            pass
+        proc.communicate()
+        log(f"[{name}] TIMEOUT after {timeout_s}s (process group killed)")
+        record({"stage": name, "ok": False, "error": "timeout",
+                "wall_s": round(time.time() - t0, 1)})
+        return False
+    r = subprocess.CompletedProcess(proc.args, proc.returncode, out, err)
+    tail = (r.stderr or "")[-2500:]
+    log(f"[{name}] rc={r.returncode} ({time.time() - t0:.0f}s)\n{tail}")
+    payload = None
+    for line in reversed((r.stdout or "").strip().splitlines()):
+        try:
+            cand = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(cand, dict):  # stray numbers/nulls are not results
+            payload = cand
+            break
+    record({"stage": name, "ok": r.returncode == 0 and payload is not None,
+            "wall_s": round(time.time() - t0, 1),
+            **({"result": payload} if payload is not None else {}),
+            **({} if r.returncode == 0 else {"rc": r.returncode})})
+    return r.returncode == 0
+
+
+COMMON = """
+import json, time
+import jax, numpy as np
+from fks_tpu.data import TraceParser
+from fks_tpu.models import parametric
+from fks_tpu.parallel import make_population_eval
+from fks_tpu.sim.engine import SimConfig
+
+def bench_pop(engine, pop, reps=2):
+    wl = TraceParser().parse_workload()
+    cfg = SimConfig(max_steps=4 * wl.num_pods, track_ctime=False)
+    params = parametric.init_population(jax.random.PRNGKey(0), pop, noise=0.1)
+    ev = make_population_eval(wl, cfg=cfg, engine=engine)
+    t0 = time.perf_counter()
+    res = ev(params); jax.block_until_ready(res.policy_score)
+    compile_s = time.perf_counter() - t0
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        res = ev(params); jax.block_until_ready(res.policy_score)
+        times.append(time.perf_counter() - t0)
+    best = min(times)
+    return {"engine": engine, "pop": pop, "compile_s": round(compile_s, 2),
+            "best_s": round(best, 3), "evals_per_sec": round(pop / best, 1),
+            "truncated": int(np.asarray(res.truncated).sum()),
+            "events_mean": int(np.asarray(res.events_processed).mean())}
+"""
+
+STAGES = {
+    "probe": (90, """
+import json, jax
+d = jax.devices()[0]
+print(json.dumps({"platform": d.platform, "kind": d.device_kind}))
+"""),
+    "flat": (600, COMMON + """
+print(json.dumps(bench_pop("flat", 256)))
+"""),
+    "fused64": (600, COMMON + """
+print(json.dumps(bench_pop("fused", 64)))
+"""),
+    "fused256": (900, COMMON + """
+print(json.dumps(bench_pop("fused", 256)))
+"""),
+    "gate": (600, """
+import json
+import jax, numpy as np
+from fks_tpu.data import TraceParser
+from fks_tpu.models import parametric
+from fks_tpu.parallel import make_population_eval
+from fks_tpu.sim.engine import SimConfig
+wl = TraceParser().parse_workload()
+cfg = SimConfig(max_steps=4 * wl.num_pods, track_ctime=False)
+params = parametric.init_population(jax.random.PRNGKey(0), 8, noise=0.1)
+a = make_population_eval(wl, cfg=cfg, engine="fused")(params)
+b = make_population_eval(wl, cfg=cfg, engine="flat")(params)
+jax.block_until_ready((a.policy_score, b.policy_score))
+sa, sb = np.asarray(a.policy_score), np.asarray(b.policy_score)
+ok = (np.allclose(sa, sb, rtol=2e-5, atol=2e-5)
+      and np.array_equal(np.asarray(a.scheduled_pods),
+                         np.asarray(b.scheduled_pods))
+      and np.array_equal(np.asarray(a.events_processed),
+                         np.asarray(b.events_processed)))
+print(json.dumps({"gate_ok": bool(ok), "fused": sa.round(4).tolist(),
+                  "flat": sb.round(4).tolist()}))
+assert ok
+"""),
+    "tiers": (1200, """
+import subprocess, sys, os
+r = subprocess.run([sys.executable, "tools/measure_tiers.py",
+                    "--engine", "flat", "--pop", "16",
+                    "--metrics", "benchmarks/results/round2_tpu.jsonl"],
+                   text=True, capture_output=True)
+sys.stderr.write(r.stderr[-2000:])
+print(r.stdout.strip().splitlines()[-1] if r.stdout.strip() else "{}")
+sys.exit(r.returncode)
+"""),
+    "scale": (900, """
+import json, time
+import jax, numpy as np
+from fks_tpu.data.synthetic import synthetic_workload
+from fks_tpu.models import parametric
+from fks_tpu.parallel import make_population_eval
+from fks_tpu.sim.engine import SimConfig
+wl = synthetic_workload(1000, 20000, seed=0)
+cfg = SimConfig(track_ctime=False)
+pop = 8
+params = parametric.init_population(jax.random.PRNGKey(0), pop, noise=0.1)
+ev = make_population_eval(wl, cfg=cfg, engine="flat")
+t0 = time.perf_counter()
+res = ev(params); jax.block_until_ready(res.policy_score)
+compile_s = time.perf_counter() - t0
+t0 = time.perf_counter()
+res = ev(params); jax.block_until_ready(res.policy_score)
+best = time.perf_counter() - t0
+print(json.dumps({"nodes": 1000, "pods": 20000, "pop": pop,
+                  "compile_s": round(compile_s, 1), "best_s": round(best, 2),
+                  "evals_per_sec": round(pop / best, 3)}))
+"""),
+}
+
+ORDER = ["probe", "flat", "fused64", "gate", "fused256", "tiers", "scale"]
+
+
+def main():
+    stages = sys.argv[1:] or ORDER
+    unknown = [s for s in stages if s not in STAGES]
+    if unknown:
+        log(f"unknown stage(s) {unknown}; valid: {list(STAGES)}")
+        return 2
+    os.makedirs(os.path.dirname(OUT), exist_ok=True)
+    for name in stages:
+        timeout_s, code = STAGES[name]
+        ok = run_stage(name, code, timeout_s)
+        if name == "probe" and not ok:
+            log("device unreachable; aborting session")
+            return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
