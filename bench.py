@@ -35,8 +35,9 @@ executions over ~60 s and can leave the device wedged afterwards):
 Env knobs: FKS_BENCH_POP (total population, default 512),
 FKS_BENCH_CHUNK (per-device-call lanes, default 256),
 FKS_BENCH_REPS (timed repetitions, default 2),
-FKS_BENCH_ENGINE (flat|exact|fused, default flat; "fused" = the Pallas
-whole-loop-in-VMEM kernel, fks_tpu/sim/fused.py),
+FKS_BENCH_ENGINE (auto|flat|exact|fused, default auto; "fused" = the
+Pallas whole-loop-in-VMEM kernel, fks_tpu/sim/fused.py; "auto" tries
+fused first and falls back to flat on any failure),
 FKS_BENCH_DEADLINE_S (controller budget for ALL stages, default 2400).
 Stages run as ``python bench.py --stage parity|throughput`` (argv, not env,
 so a leaked variable can't turn the top-level run into a bare stage).
@@ -71,7 +72,8 @@ def _probe_backend(budget_s: int):
     finishes the orphaned execution, so retry while the budget lasts.
     ALL attempts and inter-attempt sleeps stay inside ``budget_s`` (the
     controller promises the driver a JSON line within its deadline).
-    Returns None when healthy, else an error string."""
+    Returns ``(error, platform)``: (None, "tpu"/"cpu"/...) when healthy,
+    (error string, None) otherwise."""
     deadline = time.monotonic() + budget_s
     last = None
     attempt = 0
@@ -82,7 +84,8 @@ def _probe_backend(budget_s: int):
         attempt += 1
         try:
             r = subprocess.run(
-                [sys.executable, "-c", "import jax; jax.devices()"],
+                [sys.executable, "-c",
+                 "import jax; print(jax.devices()[0].platform)"],
                 timeout=min(120, remaining), capture_output=True, text=True)
         except subprocess.TimeoutExpired:
             last = "device backend initialization timed out (wedged tunnel?)"
@@ -94,8 +97,9 @@ def _probe_backend(budget_s: int):
                 f"\n{r.stderr[-2000:]}")
             time.sleep(max(0, min(30, deadline - time.monotonic())))
             continue
-        return None
-    return last
+        plat = r.stdout.strip().splitlines()[-1] if r.stdout.strip() else ""
+        return None, plat
+    return (last or "backend probe budget exhausted"), None
 
 
 # ---------------------------------------------------------------- stages
@@ -248,8 +252,12 @@ def main():
     pop = int(os.environ.get("FKS_BENCH_POP", "512"))
     chunk = min(int(os.environ.get("FKS_BENCH_CHUNK", "256")), pop)
     reps = int(os.environ.get("FKS_BENCH_REPS", "2"))
-    engine = os.environ.get("FKS_BENCH_ENGINE", "flat")
+    engine = os.environ.get("FKS_BENCH_ENGINE", "auto")
 
+    if stage:
+        # stages need a concrete engine; the controller resolves "auto"
+        # via env_extra — a bare stage invocation gets the flat default
+        engine = "flat" if engine == "auto" else engine
     if stage == "parity":
         return stage_parity(engine)
     if stage == "throughput":
@@ -279,30 +287,46 @@ def main():
         return _fail("parity gate did not pass (fitness mismatch, "
                      "timeout, or crash — see stderr)")
 
-    err = _probe_backend(budget_s=max(30, budget() - 180))
+    err, platform = _probe_backend(budget_s=max(30, budget() - 180))
     if err:
         log(f"backend probe: {err}")
         return _fail(err)
+    log(f"device platform: {platform}")
 
+    # "auto": try the fused Pallas kernel first, falling back to the XLA
+    # flat engine on ANY fused failure (Mosaic compile, device gate,
+    # timeout) — the headline should be the fastest engine that actually
+    # works here. Off-TPU the fused kernel would run in the (slow) pallas
+    # interpreter, so auto resolves straight to flat there.
+    if engine == "auto":
+        engines = ["fused", "flat"] if platform == "tpu" else ["flat"]
+    else:
+        engines = [engine]
+    eng_i = 0
     while True:
         if budget() < 120:
             return _fail("benchmark deadline exhausted")
         out = _run_stage(
             "throughput",
             {"FKS_BENCH_POP": str(pop), "FKS_BENCH_CHUNK": str(chunk),
-             "FKS_BENCH_REPS": str(reps)},
+             "FKS_BENCH_REPS": str(reps),
+             "FKS_BENCH_ENGINE": engines[eng_i]},
             timeout_s=min(900, budget()))
         if out is not None:
             break
-        if chunk <= 8:
+        if eng_i + 1 < len(engines):
+            eng_i += 1
+            log(f"falling back to engine={engines[eng_i]}")
+        elif chunk > 8:
+            chunk //= 4
+            pop = max(chunk, pop // 4)
+            log(f"retrying throughput with chunk={chunk} pop={pop}")
+        else:
             return _fail("throughput stage failed at minimum chunk size")
-        chunk //= 4
-        pop = max(chunk, pop // 4)
-        log(f"retrying throughput with chunk={chunk} pop={pop}")
         if budget() < 120:
             return _fail("benchmark deadline exhausted")
         # keep the probe inside the deadline too (leave room for the rerun)
-        err = _probe_backend(budget_s=max(30, budget() - 180))
+        err, _ = _probe_backend(budget_s=max(30, budget() - 180))
         if err:
             log(f"backend probe: {err}")
             return _fail(err)

@@ -255,10 +255,8 @@ def cmd_scale(args):
                 scores = res.policy_score
                 mode = "vmap on 1 device"
         except ValueError as e:
-            if args.engine != "fused":
-                raise
-            # the fused kernel's VMEM guard: fail with guidance, not a
-            # traceback (the shape fits the XLA engines)
+            if args.engine != "fused" or "VMEM" not in str(e):
+                raise  # only the fused kernel's VMEM guard gets guidance
             print(f"error: {e}\n(try smaller --nodes-count/--pods-count, "
                   f"or --engine flat)", file=sys.stderr)
             return 2

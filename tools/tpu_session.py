@@ -58,11 +58,10 @@ def run_stage(name, code, timeout_s):
         record({"stage": name, "ok": False, "error": "timeout",
                 "wall_s": round(time.time() - t0, 1)})
         return False
-    r = subprocess.CompletedProcess(proc.args, proc.returncode, out, err)
-    tail = (r.stderr or "")[-2500:]
-    log(f"[{name}] rc={r.returncode} ({time.time() - t0:.0f}s)\n{tail}")
+    rc = proc.returncode
+    log(f"[{name}] rc={rc} ({time.time() - t0:.0f}s)\n{(err or '')[-2500:]}")
     payload = None
-    for line in reversed((r.stdout or "").strip().splitlines()):
+    for line in reversed((out or "").strip().splitlines()):
         try:
             cand = json.loads(line)
         except json.JSONDecodeError:
@@ -70,11 +69,11 @@ def run_stage(name, code, timeout_s):
         if isinstance(cand, dict):  # stray numbers/nulls are not results
             payload = cand
             break
-    record({"stage": name, "ok": r.returncode == 0 and payload is not None,
+    record({"stage": name, "ok": rc == 0 and payload is not None,
             "wall_s": round(time.time() - t0, 1),
             **({"result": payload} if payload is not None else {}),
-            **({} if r.returncode == 0 else {"rc": r.returncode})})
-    return r.returncode == 0
+            **({} if rc == 0 else {"rc": rc})})
+    return rc == 0
 
 
 COMMON = """
