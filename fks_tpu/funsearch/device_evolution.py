@@ -25,6 +25,7 @@ import dataclasses
 from typing import Callable, List, Optional
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from fks_tpu.models import parametric
@@ -95,3 +96,54 @@ class ParametricEvolution:
     def best_code(self) -> str:
         """The champion weights rendered as reference-style source."""
         return parametric.render_code(np.asarray(self.best_params))
+
+    # ------------------------------------------------------------ resume
+    # The code-candidate loop (fks_tpu.funsearch.evolution) checkpoints
+    # population + RNG; long device-resident runs need the same (the
+    # reference has no resume at all — SURVEY.md §5).
+
+    def save_checkpoint(self, path: str) -> str:
+        """Everything needed to continue deterministically: padded params,
+        RNG key, champion, and history. Returns the file actually written
+        (np.savez appends ``.npz`` when missing)."""
+        if not path.endswith(".npz"):
+            path += ".npz"
+        hist = np.array([[h.generation, h.best_score, h.mean_score]
+                         for h in self.history], np.float64).reshape(-1, 3)
+        best = (np.asarray(self._best_params) if self._best_params is not None
+                else np.zeros(0, np.float32))
+        np.savez(path, params=self._host_params(),
+                 key=np.asarray(self._key), generation=self.generation,
+                 best_score=self.best_score, best_params=best,
+                 real_count=self.real_count, history=hist)
+        return path
+
+    def _host_params(self) -> np.ndarray:
+        """Full population on host — gathers across processes when the
+        mesh spans hosts (np.asarray alone raises on arrays that are not
+        fully addressable)."""
+        arr = self.params
+        if getattr(arr, "is_fully_addressable", True):
+            return np.asarray(arr)
+        from jax.experimental import multihost_utils
+        return np.asarray(multihost_utils.process_allgather(
+            arr, tiled=True))
+
+    def restore_checkpoint(self, path: str) -> None:
+        """Restore onto an instance built with the SAME workload/mesh/
+        engine/pop_size; continuing reproduces the uninterrupted run
+        exactly (same key-split sequence)."""
+        d = np.load(path)
+        if d["params"].shape != tuple(self.params.shape):
+            raise ValueError(
+                f"checkpoint population shape {d['params'].shape} != this "
+                f"instance's {tuple(self.params.shape)}")
+        self.params = jnp.asarray(d["params"])
+        self._key = jnp.asarray(d["key"])
+        self.generation = int(d["generation"])
+        self.best_score = float(d["best_score"])
+        self._best_params = (jnp.asarray(d["best_params"])
+                             if d["best_params"].size else None)
+        self.real_count = int(d["real_count"])
+        self.history = [DeviceGenStats(int(g), float(b), float(m))
+                        for g, b, m in d["history"]]

@@ -77,3 +77,42 @@ def test_funsearch_hybrid_parametric_rounds():
     # rendered form at least once (or was dedup-rejected against a better
     # incumbent — either way the loop must have evaluated it)
     assert fs.history[-1].generation == 2
+
+
+def test_checkpoint_resume_reproduces_uninterrupted_run(tmp_path):
+    """save after 2 generations -> fresh instance -> restore -> 1 more
+    generation == 3 uninterrupted generations, bit for bit."""
+    import numpy as np
+    from fks_tpu.funsearch.device_evolution import ParametricEvolution
+    from fks_tpu.sim.engine import SimConfig
+
+    wl = micro_workload()
+    kw = dict(pop_size=8, cfg=SimConfig(track_ctime=False), seed=3)
+    a = ParametricEvolution(wl, **kw)
+    a.run(2)
+    ckpt = a.save_checkpoint(str(tmp_path / "pe.npz"))
+
+    b = ParametricEvolution(wl, **kw)
+    b.restore_checkpoint(ckpt)
+    assert b.generation == 2 and b.best_score == a.best_score
+    b.run(1)
+
+    c = ParametricEvolution(wl, **kw)
+    c.run(3)
+    np.testing.assert_array_equal(np.asarray(b.params), np.asarray(c.params))
+    assert b.best_score == c.best_score
+    assert [h.best_score for h in b.history] == [h.best_score for h in c.history]
+
+
+def test_restore_rejects_mismatched_population(tmp_path):
+    import pytest as _pytest
+    from fks_tpu.funsearch.device_evolution import ParametricEvolution
+    from fks_tpu.sim.engine import SimConfig
+
+    wl = micro_workload()
+    a = ParametricEvolution(wl, pop_size=8, cfg=SimConfig(track_ctime=False))
+    a.run(1)
+    ckpt = a.save_checkpoint(str(tmp_path / "pe.npz"))
+    b = ParametricEvolution(wl, pop_size=16, cfg=SimConfig(track_ctime=False))
+    with _pytest.raises(ValueError, match="population shape"):
+        b.restore_checkpoint(ckpt)
