@@ -35,6 +35,16 @@ from fks_tpu.parallel import (
 from fks_tpu.sim.engine import SimConfig
 
 
+def _to_host(arr) -> np.ndarray:
+    """Device array -> host numpy, gathering across processes when the
+    mesh spans hosts (np.asarray alone raises on arrays that are not
+    fully addressable)."""
+    if getattr(arr, "is_fully_addressable", True):
+        return np.asarray(arr)
+    from jax.experimental import multihost_utils
+    return np.asarray(multihost_utils.process_allgather(arr, tiled=True))
+
+
 @dataclasses.dataclass
 class DeviceGenStats:
     generation: int
@@ -110,40 +120,38 @@ class ParametricEvolution:
             path += ".npz"
         hist = np.array([[h.generation, h.best_score, h.mean_score]
                          for h in self.history], np.float64).reshape(-1, 3)
-        best = (np.asarray(self._best_params) if self._best_params is not None
+        best = (_to_host(self._best_params) if self._best_params is not None
                 else np.zeros(0, np.float32))
-        np.savez(path, params=self._host_params(),
-                 key=np.asarray(self._key), generation=self.generation,
-                 best_score=self.best_score, best_params=best,
-                 real_count=self.real_count, history=hist)
+        if jax.process_index() == 0:  # one writer on shared filesystems
+            np.savez(path, params=_to_host(self.params),
+                     key=np.asarray(self._key), generation=self.generation,
+                     best_score=self.best_score, best_params=best,
+                     real_count=self.real_count, history=hist)
         return path
-
-    def _host_params(self) -> np.ndarray:
-        """Full population on host — gathers across processes when the
-        mesh spans hosts (np.asarray alone raises on arrays that are not
-        fully addressable)."""
-        arr = self.params
-        if getattr(arr, "is_fully_addressable", True):
-            return np.asarray(arr)
-        from jax.experimental import multihost_utils
-        return np.asarray(multihost_utils.process_allgather(
-            arr, tiled=True))
 
     def restore_checkpoint(self, path: str) -> None:
         """Restore onto an instance built with the SAME workload/mesh/
         engine/pop_size; continuing reproduces the uninterrupted run
         exactly (same key-split sequence)."""
-        d = np.load(path)
-        if d["params"].shape != tuple(self.params.shape):
-            raise ValueError(
-                f"checkpoint population shape {d['params'].shape} != this "
-                f"instance's {tuple(self.params.shape)}")
-        self.params = jnp.asarray(d["params"])
-        self._key = jnp.asarray(d["key"])
-        self.generation = int(d["generation"])
-        self.best_score = float(d["best_score"])
-        self._best_params = (jnp.asarray(d["best_params"])
-                             if d["best_params"].size else None)
-        self.real_count = int(d["real_count"])
-        self.history = [DeviceGenStats(int(g), float(b), float(m))
-                        for g, b, m in d["history"]]
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from fks_tpu.parallel.mesh import _pop_axes
+
+        with np.load(path) as d:
+            if d["params"].shape != tuple(self.params.shape):
+                raise ValueError(
+                    f"checkpoint population shape {d['params'].shape} != "
+                    f"this instance's {tuple(self.params.shape)}")
+            # re-establish the mesh sharding (every process holds the full
+            # array, so device_put builds the same global array everywhere)
+            self.params = jax.device_put(
+                jnp.asarray(d["params"]),
+                NamedSharding(self.mesh, P(_pop_axes(self.mesh))))
+            self._key = jnp.asarray(d["key"])
+            self.generation = int(d["generation"])
+            self.best_score = float(d["best_score"])
+            self._best_params = (jnp.asarray(d["best_params"])
+                                 if d["best_params"].size else None)
+            self.real_count = int(d["real_count"])
+            self.history = [DeviceGenStats(int(g), float(b), float(m))
+                            for g, b, m in d["history"]]
