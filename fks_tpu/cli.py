@@ -183,6 +183,8 @@ def cmd_evolve(args):
            else EvolutionConfig())
     if args.generations is not None:
         cfg.generations = args.generations
+    if args.parametric_rounds is not None:
+        cfg.parametric_rounds = args.parametric_rounds
     backend = FakeLLM(seed=cfg.seed) if args.fake_llm else None
     if backend is None and not cfg.llm.api_key:
         print("no API key in config; use --fake-llm for hermetic runs",
@@ -331,6 +333,11 @@ def main(argv=None) -> int:
     e.add_argument("--checkpoint", default="", help="evolution checkpoint path")
     e.add_argument("--out", default="", help="directory for champion JSONs")
     e.add_argument("--generations", type=int, default=None)
+    e.add_argument("--parametric-rounds", type=int, default=None,
+                   help="device-resident weight-evolution generations to "
+                        "interleave per LLM generation (hybrid mode; the "
+                        "champion is rendered to source and competes in "
+                        "the code population)")
     e.set_defaults(fn=cmd_evolve)
 
     sc = sub.add_parser("scale", help="synthetic scale run + throughput",
