@@ -60,12 +60,12 @@ def test_flat_matches_exact_on_failure_free_fuzz_cases():
 
 
 def test_fused_matches_flat_on_fuzz_subset():
-    wls = _workloads()[::5][:10]  # deterministic spread across the corpus
+    wls = _workloads()[::8][:6]  # deterministic spread across the corpus
     cfg = SimConfig(track_ctime=False)
-    params = parametric.init_population(jax.random.PRNGKey(9), 6, noise=0.6)
+    params = parametric.init_population(jax.random.PRNGKey(9), 4, noise=0.6)
     saw_failures = 0
     for wl in wls:
-        run = fused.make_fused_population_run(wl, cfg, lanes=8,
+        run = fused.make_fused_population_run(wl, cfg, lanes=4,
                                               interpret=True)
         res = run(params)
         ref = flat.make_population_run_fn(wl, parametric.score, cfg)(
@@ -81,4 +81,4 @@ def test_fused_matches_flat_on_fuzz_subset():
         np.testing.assert_allclose(
             np.asarray(res.policy_score), np.asarray(ref.policy_score),
             rtol=2e-6, atol=2e-6)
-    assert saw_failures >= 3  # the subset must exercise the failure paths
+    assert saw_failures >= 2  # the subset must exercise the failure paths

@@ -109,7 +109,11 @@ def test_default_trace_close_to_exact(default_workload):
     best_fit 0.013, funsearch_4901 0.029 — chaotic snowballing from single
     retry-time differences, not systematic bias."""
     cfg = SimConfig()
-    for name in ("first_fit", "best_fit", "funsearch_4901"):
+    # two policies bound the divergence spectrum (first_fit: 3k retries,
+    # funsearch_4901: 11k — PROFILE.md); best_fit sits between and is
+    # covered by bench.py's parity gate. One fewer full-trace CPU run
+    # matters on this single-core container.
+    for name in ("first_fit", "funsearch_4901"):
         exact = simulate(default_workload, zoo.ZOO[name](), cfg)
         fastr = flat.simulate(default_workload, zoo.ZOO[name](), cfg)
         assert int(fastr.scheduled_pods) == int(exact.scheduled_pods), name
