@@ -8,7 +8,7 @@ against each other on the same corpus:
   (the engines share all semantics except the retry-time rule, which
   only fires on failures — fks_tpu/sim/flat.py);
 - fused vs flat: identical integer observables on a deterministic subset
-  (interpret mode is slow, so 10 cases x 6 parametric candidates) —
+  (interpret mode is slow, so 6 cases x 4 parametric candidates) —
   including cases WITH retries, drops, and fragmentation, where the two
   must still agree event for event.
 """

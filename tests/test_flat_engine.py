@@ -110,15 +110,18 @@ def test_default_trace_close_to_exact(default_workload):
     retry-time differences, not systematic bias."""
     cfg = SimConfig()
     # two policies bound the divergence spectrum (first_fit: 3k retries,
-    # funsearch_4901: 11k — PROFILE.md); best_fit sits between and is
-    # covered by bench.py's parity gate. One fewer full-trace CPU run
-    # matters on this single-core container.
+    # funsearch_4901: 11k — PROFILE.md); best_fit sits between, checked
+    # against its golden constants below without a second exact-engine
+    # run (one fewer full-trace CPU pass matters on this single core).
     for name in ("first_fit", "funsearch_4901"):
         exact = simulate(default_workload, zoo.ZOO[name](), cfg)
         fastr = flat.simulate(default_workload, zoo.ZOO[name](), cfg)
         assert int(fastr.scheduled_pods) == int(exact.scheduled_pods), name
         d = abs(float(fastr.policy_score) - float(exact.policy_score))
         assert d < 4e-2, (name, d)
+    bf = flat.simulate(default_workload, zoo.ZOO["best_fit"](), cfg)
+    assert int(bf.scheduled_pods) == 8152  # golden: all placed
+    assert abs(float(bf.policy_score) - 0.4465) < 4e-2
 
 
 def test_population_with_truncating_lane_terminates():
