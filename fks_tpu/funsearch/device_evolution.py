@@ -105,7 +105,7 @@ class ParametricEvolution:
 
     def best_code(self) -> str:
         """The champion weights rendered as reference-style source."""
-        return parametric.render_code(np.asarray(self.best_params))
+        return parametric.render_code(_to_host(self.best_params))
 
     # ------------------------------------------------------------ resume
     # The code-candidate loop (fks_tpu.funsearch.evolution) checkpoints
@@ -137,6 +137,8 @@ class ParametricEvolution:
 
         from fks_tpu.parallel.mesh import _pop_axes
 
+        if not path.endswith(".npz"):  # mirror save_checkpoint's normalize
+            path += ".npz"
         with np.load(path) as d:
             if d["params"].shape != tuple(self.params.shape):
                 raise ValueError(

@@ -1,0 +1,122 @@
+"""Search the parametric policy space for a champion on a real trace.
+
+Runs the device-resident weight evolution (fks_tpu.funsearch.
+device_evolution) against a trace, then re-scores the champion through
+the EXACT engine (the bit-for-bit reference replica) so the reported
+fitness is directly comparable to the reference's published numbers
+(README parity table; funsearch_4901 = 0.4901 is the bar).
+
+The champion is persisted in the reference's discovered-policy JSON
+schema (reference: funsearch/funsearch_integration.py:606-633) with the
+rendered source, so it can be dropped into either framework.
+
+Usage:
+  python -u tools/discover.py [--engine fused] [--gens 40] [--pop 32]
+      [--seed 0] [--out policies/discovered] [--checkpoint CK [--resume]]
+      [--metrics FILE]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--engine", default="flat",
+                    choices=("exact", "flat", "fused"))
+    ap.add_argument("--gens", type=int, default=40)
+    ap.add_argument("--pop", type=int, default=32)
+    ap.add_argument("--elite-k", type=int, default=4)
+    ap.add_argument("--noise", type=float, default=0.08)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--cpu", action="store_true")
+    ap.add_argument("--out", default="")
+    ap.add_argument("--checkpoint", default="")
+    ap.add_argument("--resume", action="store_true",
+                    help="restore from --checkpoint before searching")
+    ap.add_argument("--metrics", default="")
+    args = ap.parse_args()
+
+    import jax
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+
+    from fks_tpu.data import TraceParser
+    from fks_tpu.funsearch.device_evolution import ParametricEvolution
+    from fks_tpu.models import parametric
+    from fks_tpu.sim.engine import SimConfig, simulate
+
+    wl = TraceParser().parse_workload()
+    print(f"workload: {wl.num_nodes} nodes x {wl.num_pods} pods; "
+          f"engine={args.engine} pop={args.pop} gens={args.gens}",
+          file=sys.stderr, flush=True)
+
+    pe = ParametricEvolution(
+        wl, pop_size=args.pop, elite_k=args.elite_k, noise=args.noise,
+        cfg=SimConfig(track_ctime=False), engine=args.engine,
+        seed=args.seed)
+    if args.resume:
+        pe.restore_checkpoint(args.checkpoint)
+        print(f"resumed at generation {pe.generation} "
+              f"(best {pe.best_score:.4f})", file=sys.stderr)
+    t0 = time.time()
+
+    def on_gen(st):
+        print(f"gen {st.generation}: best {st.best_score:.4f} "
+              f"mean {st.mean_score:.4f} ({time.time() - t0:.0f}s)",
+              file=sys.stderr, flush=True)
+        if args.metrics:
+            with open(args.metrics, "a") as f:
+                f.write(json.dumps({
+                    "ts": round(time.time(), 1), "kind": "discover_gen",
+                    "engine": args.engine, "generation": st.generation,
+                    "best": st.best_score, "mean": st.mean_score}) + "\n")
+        if args.checkpoint and st.generation % 10 == 0:
+            pe.save_checkpoint(args.checkpoint)
+
+    pe.run(args.gens, on_generation=on_gen)
+    if args.checkpoint:
+        pe.save_checkpoint(args.checkpoint)
+
+    # re-score the champion through the exact (reference-replica) engine
+    from fks_tpu.funsearch.device_evolution import _to_host
+    weights = _to_host(pe.best_params)
+    exact = simulate(wl, parametric.as_policy(weights))
+    exact_score = float(exact.policy_score)
+    print(f"champion: search-engine score {pe.best_score:.4f}; EXACT-engine "
+          f"score {exact_score:.4f}; scheduled "
+          f"{int(exact.scheduled_pods)}/{wl.num_pods}",
+          file=sys.stderr, flush=True)
+
+    # reference discovered-policy schema {score, generation, code,
+    # timestamp} + provenance extras, same filename pattern as
+    # evolution.save_best_policy so downstream globs pick both up
+    stamp = time.strftime("%Y%m%d_%H%M%S")
+    result = {
+        "score": exact_score,
+        "generation": pe.generation,
+        "code": parametric.render_code(weights),
+        "timestamp": time.strftime("%Y-%m-%dT%H:%M:%S"),
+        "search_score": pe.best_score,
+        "engine": args.engine,
+        "weights": [float(w) for w in weights],
+    }
+    if args.out:
+        os.makedirs(args.out, exist_ok=True)
+        path = os.path.join(
+            args.out, f"funsearch_{stamp}_score{exact_score:.4f}.json")
+        with open(path, "w") as f:
+            json.dump(result, f, indent=2)
+        print(f"saved {path}", file=sys.stderr)
+    print(json.dumps({k: v for k, v in result.items() if k != "code"}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
