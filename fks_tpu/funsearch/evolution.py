@@ -44,6 +44,11 @@ class LLMSettings:
     model: str = "deepseek/deepseek-chat-v3-0324"
     max_tokens: int = 500
     temperature: float = 0.7
+    # reachable from llm_config.json (unlike the reference, which rides the
+    # SDK's 600 s default and retries): one hung request must not stall a
+    # generation's thread-pool slot for 10 minutes
+    timeout: float = 60.0
+    max_retries: int = 2
 
 
 @dataclasses.dataclass
@@ -93,6 +98,8 @@ class EvolutionConfig:
                 model=lm.get("model", LLMSettings.model),
                 max_tokens=lm.get("max_tokens", 500),
                 temperature=lm.get("temperature", 0.7),
+                timeout=lm.get("timeout", LLMSettings.timeout),
+                max_retries=lm.get("max_retries", LLMSettings.max_retries),
             ),
         )
 
@@ -132,7 +139,9 @@ class FunSearch:
             if config.llm.api_key:
                 backend = llm_mod.OpenAIBackend(
                     config.llm.api_key, config.llm.base_url, config.llm.model,
-                    config.llm.max_tokens, config.llm.temperature)
+                    config.llm.max_tokens, config.llm.temperature,
+                    timeout=config.llm.timeout,
+                    max_retries=config.llm.max_retries)
             else:
                 backend = llm_mod.FakeLLM(seed=config.seed)
         self.generator = llm_mod.CandidateGenerator(backend)
@@ -146,6 +155,16 @@ class FunSearch:
         # NOT checkpointed — rendered champions persist via the code
         # population instead)
         self._device_evo = None
+        # fast-engine searches (flat/fused) report fitness under relaxed
+        # retry semantics, which is NOT comparable to the reference's
+        # published numbers. Every NEW BEST and every persisted champion
+        # is therefore re-scored through the exact reference-replica
+        # engine; both numbers are kept. (Round-2 verdict: search-on-fast
+        # + rescore-on-exact must be the built-in default, not a tools/
+        # afterthought.)
+        self._exact_eval: Optional[CodeEvaluator] = None
+        self._exact_memo: dict = {}  # canonical AST key -> exact score
+        self.best_exact: Optional[float] = None
 
     # ----- population mechanics (reference funsearch_integration.py:174-215)
 
@@ -178,11 +197,41 @@ class FunSearch:
                     return True
         return False
 
+    def _exact_score(self, code: str, score: float) -> float:
+        """Fitness under the exact reference-replica engine. Identity when
+        the search engine already IS exact; otherwise one VM-tier (or
+        cached-jit) run of fks_tpu.sim.engine, memoized per canonical AST
+        so NEW-BEST logging and the save paths never re-simulate the same
+        candidate. A failed rescore maps to 0.0 — same rule the reference
+        applies to any failed evaluation (reference:
+        funsearch_integration.py:63-64)."""
+        if self.evaluator.engine == "exact":
+            return score
+        from fks_tpu.funsearch import transpiler
+        try:
+            key = transpiler.canonical_key(code)
+        except SyntaxError:
+            return 0.0
+        if key in self._exact_memo:
+            return self._exact_memo[key]
+        if self._exact_eval is None:
+            self._exact_eval = CodeEvaluator(
+                self.evaluator.workload, self.evaluator.cfg, engine="exact")
+        exact = self._exact_eval.evaluate_one(code).score
+        self._exact_memo[key] = exact
+        return exact
+
     def _admit(self, code: str, score: float) -> None:
         self.population.append((code, score))
         if self.best is None or score > self.best[1]:
             self.best = (code, score)
-            self.log(f"  NEW BEST {score:.4f} (gen {self.generation})")
+            self.best_exact = self._exact_score(code, score)
+            if self.evaluator.engine == "exact":
+                self.log(f"  NEW BEST {score:.4f} (gen {self.generation})")
+            else:
+                self.log(f"  NEW BEST {score:.4f} "
+                         f"[{self.evaluator.engine}] = {self.best_exact:.4f} "
+                         f"[exact] (gen {self.generation})")
 
     def _sample_parents(self) -> Sequence[Member]:
         """<=2 random elites as prompt parents (reference:
@@ -291,18 +340,36 @@ class FunSearch:
 
     # ----- persistence (reference funsearch_integration.py:606-679) + resume
 
+    def _champion_fields(self, code: str, score: float) -> dict:
+        """The persisted ``score`` is ALWAYS exact-engine fitness — the only
+        number comparable to the reference's published table. When the
+        search ran on a fast engine, the raw search fitness and the engine
+        name ride along as ``search_score``/``search_engine``."""
+        exact = self._exact_score(code, score)
+        fields = {"score": exact}
+        if self.evaluator.engine != "exact":
+            fields["search_score"] = score
+            fields["search_engine"] = self.evaluator.engine
+        return fields
+
     def save_top_policies(self, directory: str, k: int = 5) -> str:
         """Champion JSON with rank/score/generation/code/timestamp schema
-        (reference: funsearch_integration.py:635-679)."""
+        (reference: funsearch_integration.py:635-679). Fast-engine
+        searches take the top ``k`` by search fitness, then RANK the
+        payload by exact-engine fitness — a consumer reading rank 1 gets
+        the exact-engine best of the rescored set, and the listed scores
+        are monotonic."""
         os.makedirs(directory, exist_ok=True)
         stamp = time.strftime("%Y%m%d_%H%M%S")
         path = os.path.join(directory, f"top_policies_{stamp}.json")
         self._sort()
-        payload = [
-            {"rank": i + 1, "score": s, "generation": self.generation,
+        entries = [
+            {**self._champion_fields(c, s), "generation": self.generation,
              "code": c, "timestamp": stamp}
-            for i, (c, s) in enumerate(self.population[:k])
+            for c, s in self.population[:k]
         ]
+        entries.sort(key=lambda e: e["score"], reverse=True)
+        payload = [{"rank": i + 1, **e} for i, e in enumerate(entries)]
         with open(path, "w") as f:
             json.dump(payload, f, indent=2)
         return path
@@ -310,15 +377,27 @@ class FunSearch:
     def save_best_policy(self, directory: str = "policies/discovered") -> str:
         """Single-champion JSON, reference schema {score, generation, code,
         timestamp} and filename pattern ``funsearch_<stamp>_score<s>.json``
-        (reference: funsearch_integration.py:606-633)."""
+        (reference: funsearch_integration.py:606-633). The score in both
+        the filename and the payload is exact-engine fitness; for
+        fast-engine searches the saved champion is the exact-engine best
+        among the rescored top-5 (search order and exact order can
+        disagree, and the persisted 'best' must honor the persisted
+        metric)."""
         if self.best is None:
             raise ValueError("no best policy to save")
-        code, score = self.best
+        self._sort()
+        candidates = list(self.population[:5])
+        if self.best not in candidates:
+            candidates.append(self.best)
+        code, score = max(
+            candidates, key=lambda m: self._exact_score(m[0], m[1]))
+        fields = self._champion_fields(code, score)
         os.makedirs(directory, exist_ok=True)
         stamp = time.strftime("%Y%m%d_%H%M%S")
-        path = os.path.join(directory, f"funsearch_{stamp}_score{score:.4f}.json")
+        path = os.path.join(
+            directory, f"funsearch_{stamp}_score{fields['score']:.4f}.json")
         with open(path, "w") as f:
-            json.dump({"score": score, "generation": self.generation,
+            json.dump({**fields, "generation": self.generation,
                        "code": code,
                        "timestamp": time.strftime("%Y-%m-%dT%H:%M:%S")},
                       f, indent=2)
@@ -334,6 +413,7 @@ class FunSearch:
             "population": [{"code": c, "score": s} for c, s in self.population],
             "best": ({"code": self.best[0], "score": self.best[1]}
                      if self.best else None),
+            "best_exact": self.best_exact,
             "rng_state": _encode_rng(self.rng.getstate()),
             "config": dataclasses.asdict(self.cfg),
         }
@@ -357,6 +437,7 @@ class FunSearch:
         self.population = [(m["code"], m["score"]) for m in state["population"]]
         self.best = ((state["best"]["code"], state["best"]["score"])
                      if state["best"] else None)
+        self.best_exact = state.get("best_exact")
         self.rng.setstate(_decode_rng(state["rng_state"]))
         backend = self.generator.backend
         if "backend_state" in state and hasattr(backend, "setstate"):
