@@ -34,32 +34,68 @@ class TextBackend(Protocol):
 
 
 class OpenAIBackend:
-    """Thin OpenAI-SDK adapter (reference: safe_execution.py:283-303).
+    """OpenAI-compatible chat/completions client, self-contained over
+    stdlib HTTP (reference: safe_execution.py:283-303 does the same call
+    through the ``openai`` SDK against OpenRouter).
 
-    The ``openai`` import is deferred and optional: environments without the
-    SDK (or without network egress) use ``FakeLLM``.
+    Dropping the SDK is deliberate: the request is one POST with a JSON
+    body and the response is one JSON object — a dependency-free client
+    keeps the framework runnable (and this path hermetically testable,
+    tests/test_llm_stub.py) in images without the SDK. Unlike the
+    reference, timeout and retry policy are explicit: the SDK's 600 s
+    default timeout stalls a whole generation's thread-pool slot on one
+    hung request.
+
+    Wire behavior: POST ``{base_url}/chat/completions`` with
+    ``{model, messages, max_tokens, temperature}`` and a Bearer key;
+    transient failures (connect/read errors, HTTP 429/5xx) retry up to
+    ``max_retries`` times with linear backoff; anything else raises —
+    ``CandidateGenerator.generate`` maps every raise to None, matching the
+    reference's None-on-any-failure contract (safe_execution.py:315-317).
     """
 
     def __init__(self, api_key: str, base_url: str, model: str,
-                 max_tokens: int = 500, temperature: float = 0.7):
-        try:
-            import openai  # noqa: PLC0415 — optional dependency
-        except ImportError as e:  # pragma: no cover - image always has it
-            raise RuntimeError(
-                "openai SDK unavailable; use FakeLLM for hermetic runs") from e
-        self._client = openai.OpenAI(api_key=api_key, base_url=base_url)
+                 max_tokens: int = 500, temperature: float = 0.7,
+                 timeout: float = 60.0, max_retries: int = 2):
+        self.api_key = api_key
+        self.base_url = base_url.rstrip("/")
         self.model = model
         self.max_tokens = max_tokens
         self.temperature = temperature
+        self.timeout = timeout
+        self.max_retries = max_retries
 
     def complete(self, prompt: str) -> str:
-        resp = self._client.chat.completions.create(
-            model=self.model,
-            messages=[{"role": "user", "content": prompt}],
-            max_tokens=self.max_tokens,
-            temperature=self.temperature,
-        )
-        return (resp.choices[0].message.content or "").strip()
+        import json  # noqa: PLC0415 — keep module imports jax-light
+        import time
+        import urllib.error
+        import urllib.request
+
+        body = json.dumps({
+            "model": self.model,
+            "messages": [{"role": "user", "content": prompt}],
+            "max_tokens": self.max_tokens,
+            "temperature": self.temperature,
+        }).encode()
+        req = urllib.request.Request(
+            f"{self.base_url}/chat/completions", data=body,
+            headers={"Content-Type": "application/json",
+                     "Authorization": f"Bearer {self.api_key}"})
+        last: Exception
+        for attempt in range(self.max_retries + 1):
+            try:
+                with urllib.request.urlopen(req, timeout=self.timeout) as r:
+                    resp = json.loads(r.read().decode())
+                return (resp["choices"][0]["message"]["content"] or "").strip()
+            except urllib.error.HTTPError as e:
+                last = e
+                if e.code not in (429, 500, 502, 503, 504):
+                    raise
+            except (urllib.error.URLError, TimeoutError, OSError) as e:
+                last = e
+            if attempt < self.max_retries:
+                time.sleep(0.5 * (attempt + 1))
+        raise last
 
 
 class FakeLLM:
