@@ -47,6 +47,7 @@ def test_rendered_champion_is_valid_candidate():
 
 
 @pytest.mark.parametrize("seed_name", ["best_fit", "packing"])
+@pytest.mark.slow
 def test_render_code_fitness_close_to_parametric(seed_name, default_workload):
     """The rendered source re-scored through the code path lands near the
     on-device parametric fitness (rendering is f64 Python vs f32 device
@@ -60,6 +61,7 @@ def test_render_code_fitness_close_to_parametric(seed_name, default_workload):
     assert int(rendered.scheduled_pods) == int(dev.scheduled_pods)
 
 
+@pytest.mark.slow
 def test_funsearch_hybrid_parametric_rounds():
     """FunSearch with parametric_rounds > 0 interleaves device rounds and
     admits the rendered champion through the normal dedup/admission path."""
@@ -79,6 +81,7 @@ def test_funsearch_hybrid_parametric_rounds():
     assert fs.history[-1].generation == 2
 
 
+@pytest.mark.slow
 def test_checkpoint_resume_reproduces_uninterrupted_run(tmp_path):
     """save after 2 generations -> fresh instance -> restore -> 1 more
     generation == 3 uninterrupted generations, bit for bit."""

@@ -20,6 +20,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow
+
 from fks_tpu.data.build import make_workload
 from fks_tpu.models import parametric, zoo
 from fks_tpu.sim import flat, fused

@@ -13,8 +13,13 @@ from fks_tpu.data import TraceParser
 from fks_tpu.models import zoo
 from fks_tpu.sim.engine import SimConfig, simulate
 
-POLICIES = ["first_fit", "best_fit", "funsearch_4901", "funsearch_4816",
-            "funsearch_4800"]
+# best_fit stays in the fast tier as the default-trace parity sentinel;
+# the other four run with the slow tier (-m slow)
+POLICIES = [pytest.param("first_fit", marks=pytest.mark.slow),
+            "best_fit",
+            pytest.param("funsearch_4901", marks=pytest.mark.slow),
+            pytest.param("funsearch_4816", marks=pytest.mark.slow),
+            pytest.param("funsearch_4800", marks=pytest.mark.slow)]
 
 
 def check_parity(res, ref, wl, tol=1e-9):
@@ -55,6 +60,7 @@ def test_default_trace_parity(name, default_workload, golden_default):
     ("openb_pod_list_gpuspec33.csv", "first_fit"),
     ("openb_pod_list_cpu250.csv", "best_fit"),
 ])
+@pytest.mark.slow
 def test_alt_trace_parity(pod_file, name, golden_alt):
     wl = TraceParser().parse_workload(pod_file=pod_file)
     policy = zoo.ZOO[name](dtype=jnp.float64)
@@ -62,6 +68,7 @@ def test_alt_trace_parity(pod_file, name, golden_alt):
     check_parity(res, golden_alt[pod_file][name], wl)
 
 
+@pytest.mark.slow
 def test_float32_fitness_within_1e5(default_workload, golden_default):
     """The TPU-fast dtype must still meet the 1e-5 north-star bar on the
     default trace (placement decisions are integer; only evaluator sums and

@@ -50,6 +50,7 @@ def _roomy_workload(num_pods=40, seed=0):
 
 @pytest.mark.parametrize("policy_name", ["first_fit", "best_fit",
                                          "funsearch_4901"])
+@pytest.mark.slow
 def test_no_retry_run_bit_identical(policy_name):
     wl = _roomy_workload()
     cfg = SimConfig()
@@ -60,6 +61,7 @@ def test_no_retry_run_bit_identical(policy_name):
     _assert_results_equal(exact, fastr)
 
 
+@pytest.mark.slow
 def test_micro_workload_bit_identical():
     wl = micro_workload()
     for name in ("first_fit", "best_fit"):
@@ -83,6 +85,7 @@ def test_refuse_all_policy_drops_everything():
     assert not bool(res.truncated)  # queue fully drained
 
 
+@pytest.mark.slow
 def test_population_run_matches_single_runs():
     from fks_tpu.models import parametric
 
@@ -102,6 +105,7 @@ def test_population_run_matches_single_runs():
                                       np.asarray(one.assigned_node))
 
 
+@pytest.mark.slow
 def test_default_trace_close_to_exact(default_workload):
     """Retry timing is the ONLY divergence; on the reference trace the
     scheduled counts must match and fitness must stay within 4e-2 for the
@@ -140,6 +144,7 @@ def test_population_with_truncating_lane_terminates():
     assert np.asarray(res.policy_score).tolist() == [0.0, 0.0, 0.0]
 
 
+@pytest.mark.slow
 def test_pod_count_not_block_multiple():
     """Regression: the slot queue pads itself to a whole number of blocks;
     workloads whose padded pod count is not a multiple of the block width
@@ -167,6 +172,7 @@ def test_invariant_audit_clean(default_workload):
     assert int(res.invariant_violations) == 0
 
 
+@pytest.mark.slow
 def test_unpacked_aux_gpus_path_bit_identical():
     """When node_bits + G > 31 the (node, gpu_bits) pair no longer fits one
     int32 aux word and the engine must fall back to a separate aux_gpus

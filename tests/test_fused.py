@@ -92,6 +92,7 @@ def _contended():
                          pad_pods_to=128)
 
 
+@pytest.mark.slow
 def test_roomy_population_matches_flat():
     wl = _roomy()
     cfg = SimConfig(track_ctime=False)
@@ -101,6 +102,7 @@ def test_roomy_population_matches_flat():
     _assert_matches(res, ref)
 
 
+@pytest.mark.slow
 def test_contended_population_matches_flat():
     """Retries, fragmentation events, silent drops, step-budget truncation
     — the full set of failure paths — must match event for event."""
@@ -112,6 +114,7 @@ def test_contended_population_matches_flat():
     _assert_matches(res, ref)
 
 
+@pytest.mark.slow
 def test_population_padding_to_lane_multiple():
     """pop not a multiple of lanes: results for the real candidates are
     unchanged by the padding rows."""

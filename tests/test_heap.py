@@ -90,6 +90,7 @@ def test_equal_time_orders_by_rank():
     assert pods == [11, 12, 10]
 
 
+@pytest.mark.slow
 def test_vmapped_heap_ops():
     def trace(times):
         h = EventHeap(

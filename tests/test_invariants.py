@@ -3,6 +3,7 @@
 a correct run reports zero violations; corrupted state is detected."""
 import dataclasses
 
+import pytest
 import jax.numpy as jnp
 import numpy as np
 
@@ -11,6 +12,7 @@ from fks_tpu.sim.engine import SimConfig, initial_state, make_run_fn, simulate
 from tests.test_engine_micro import micro_workload
 
 
+@pytest.mark.slow
 def test_micro_run_zero_violations():
     wl = micro_workload()
     res = simulate(wl, zoo.micro_best_fit(dtype=jnp.float64),
@@ -19,6 +21,7 @@ def test_micro_run_zero_violations():
     assert not bool(res.failed)
 
 
+@pytest.mark.slow
 def test_default_trace_zero_violations(default_workload):
     res = simulate(default_workload, zoo.ZOO["best_fit"](),
                    SimConfig(validate_invariants=True))

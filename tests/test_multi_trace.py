@@ -71,6 +71,7 @@ def test_stack_traces_rejects_mixed_shapes():
         stack_traces([a, b], SimConfig())
 
 
+@pytest.mark.slow
 def test_batched_matches_per_trace():
     """The one-program batched path == N independent simulations."""
     cfg = SimConfig(score_dtype=jnp.float64)
@@ -91,6 +92,7 @@ def test_batched_matches_per_trace():
                               np.asarray(single.assigned_node))
 
 
+@pytest.mark.slow
 def test_population_by_trace_matrix():
     cfg = SimConfig(score_dtype=jnp.float64)
     wls = [small(seed) for seed in (5, 6)]
@@ -106,6 +108,7 @@ def test_population_by_trace_matrix():
                        np.asarray(single.policy_score))
 
 
+@pytest.mark.slow
 def test_batched_flat_engine_matches_per_trace():
     """The flat engine drives the same stacked-trace program shape; each
     lane equals its independent flat simulation."""

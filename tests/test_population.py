@@ -50,6 +50,7 @@ def pop8():
     return parametric.init_population(key, 8, noise=0.2)
 
 
+@pytest.mark.slow
 def test_vmap_matches_single(wl, pop8):
     res = make_population_eval(wl)(pop8)
     for i in range(pop8.shape[0]):
@@ -61,6 +62,7 @@ def test_vmap_matches_single(wl, pop8):
             np.asarray(res.assigned_node)[i], np.asarray(single.assigned_node))
 
 
+@pytest.mark.slow
 def test_seed_policies_schedule_micro(wl):
     for name in ("first_fit", "best_fit", "worst_fit", "packing"):
         res = simulate(wl, parametric.as_policy(parametric.seed_weights(name)))
@@ -68,6 +70,7 @@ def test_seed_policies_schedule_micro(wl):
         assert float(res.policy_score) > 0, name
 
 
+@pytest.mark.slow
 def test_sharded_eval_matches_vmap(wl, pop8):
     mesh = population_mesh()
     assert mesh.shape[POP_AXIS] == 8  # conftest forces 8 virtual devices
@@ -100,6 +103,7 @@ def test_padded_population_excludes_pad_from_elites(wl):
     assert len(set(np.asarray(elite_idx).tolist())) == 4
 
 
+@pytest.mark.slow
 def test_generation_step_preserves_elites(wl, pop8):
     mesh = population_mesh()
     step = make_sharded_generation_step(wl, mesh, elite_k=4, noise=0.05)
@@ -118,6 +122,7 @@ def test_generation_step_preserves_elites(wl, pop8):
 
 # ---------------------------------------------------------------- hybrid mesh
 
+@pytest.mark.slow
 def test_hybrid_mesh_matches_flat_mesh(wl, pop8):
     """2-D ("dcn","pop") mesh (multi-slice topology modeled on the 8 virtual
     devices as 2 slices x 4 chips) must produce identical fitness and elite
@@ -138,6 +143,7 @@ def test_hybrid_mesh_matches_flat_mesh(wl, pop8):
     np.testing.assert_array_equal(np.asarray(elite_scores), np.asarray(flat[2]))
 
 
+@pytest.mark.slow
 def test_hybrid_generation_step_runs_and_preserves_elites(wl, pop8):
     from fks_tpu.parallel import hybrid_population_mesh
 

@@ -132,6 +132,7 @@ def test_transpiled_seeds_match_oracle():
             assert got == want, name
 
 
+@pytest.mark.slow
 def test_transpiled_policy_runs_in_engine():
     """End to end: a transpiled candidate drives the jitted simulator and
     produces the same fitness as the equivalent zoo policy."""

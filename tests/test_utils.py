@@ -5,6 +5,7 @@ SURVEY.md §5 — so the tests define their contract.)"""
 import json
 import logging
 
+import pytest
 import jax
 import jax.numpy as jnp
 
@@ -73,6 +74,7 @@ def test_metrics_writer_jsonl(tmp_path):
     assert lines[1]["best_score"] == 0.9
 
 
+@pytest.mark.slow
 def test_result_record_schema(default_workload):
     from fks_tpu.models import zoo
     from fks_tpu.sim.engine import SimConfig, simulate
@@ -101,6 +103,7 @@ def test_get_logger_single_handler():
     assert len(root.handlers) == 1
 
 
+@pytest.mark.slow
 def test_cli_metrics_flag(tmp_path, default_workload):
     from fks_tpu.cli import main
 

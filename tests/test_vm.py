@@ -39,6 +39,7 @@ def _corpus():
             + [template.fill_template(fake.complete("x")) for _ in range(30)])
 
 
+@pytest.mark.slow
 def test_corpus_lowers_and_matches_exactly():
     """Every seed + FakeLLM candidate lowers to the VM, and interpreted
     scores equal the transpiled policy's on randomized views."""
@@ -57,6 +58,7 @@ def test_corpus_lowers_and_matches_exactly():
     assert lowered == len(_corpus())
 
 
+@pytest.mark.slow
 def test_full_simulation_fitness_matches_jit_tier(default_workload):
     """Seed candidates through the shared VM engine program reproduce the
     reference fitness table exactly (first_fit 0.4292, best_fit 0.4465)."""
@@ -84,6 +86,7 @@ def test_unsupported_construct_falls_back():
         vm.compile_policy(code, N, G, capacity=512)  # ...but not VM-able
 
 
+@pytest.mark.slow
 def test_code_evaluator_uses_vm_tier(micro_workload_or_none=None):
     from fks_tpu.data.build import make_workload
 
@@ -112,6 +115,7 @@ def test_code_evaluator_uses_vm_tier(micro_workload_or_none=None):
     assert ev.compile_count == 1
 
 
+@pytest.mark.slow
 def test_vm_matches_jit_tier_scores():
     """CodeEvaluator with and without the VM tier produce identical
     fitness for the same candidates."""
