@@ -11,8 +11,10 @@ device call cannot take down the session; results append to a JSONL file
   gate      fused-vs-flat same-device parity gate (8 candidates)
   tiers     measure_tiers (VM / jit / parametric / evolve-gen) on device
   scale     synthetic 1000x20000 single-chip flat-engine run
+  scale100k BASELINE config-5 shape: 1000 nodes x 100k pods, single chip
 
 Usage: python -u tools/tpu_session.py [stage ...]   (default: all)
+Output file: benchmarks/results/round3_tpu.jsonl (FKS_SESSION_OUT to override).
 """
 from __future__ import annotations
 
@@ -23,7 +25,8 @@ import sys
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-OUT = os.path.join(REPO, "benchmarks", "results", "round2_tpu.jsonl")
+OUT = os.environ.get("FKS_SESSION_OUT") or os.path.join(
+    REPO, "benchmarks", "results", "round3_tpu.jsonl")
 
 
 def log(*a):
@@ -142,14 +145,14 @@ print(json.dumps({"gate_ok": bool(ok), "fused": sa.round(4).tolist(),
                   "flat": sb.round(4).tolist()}))
 assert ok
 """),
-    "tiers": (1200, """
+    "tiers": (1200, f"""
 import subprocess, sys, os
 r = subprocess.run([sys.executable, "tools/measure_tiers.py",
                     "--engine", "flat", "--pop", "16",
-                    "--metrics", "benchmarks/results/round2_tpu.jsonl"],
+                    "--metrics", {OUT!r}],
                    text=True, capture_output=True)
 sys.stderr.write(r.stderr[-2000:])
-print(r.stdout.strip().splitlines()[-1] if r.stdout.strip() else "{}")
+print(r.stdout.strip().splitlines()[-1] if r.stdout.strip() else "{{}}")
 sys.exit(r.returncode)
 """),
     "scale": (900, """
@@ -174,9 +177,35 @@ print(json.dumps({"nodes": 1000, "pods": 20000, "pop": pop,
                   "compile_s": round(compile_s, 1), "best_s": round(best, 2),
                   "evals_per_sec": round(pop / best, 3)}))
 """),
+    # BASELINE config 5's trace-length axis on one chip (the mesh spreads
+    # population, not the sequential event scan, so per-chip cost is the
+    # number that matters; round-2 verdict ask #6)
+    "scale100k": (1800, """
+import json, time
+import jax, numpy as np
+from fks_tpu.data.synthetic import synthetic_workload
+from fks_tpu.models import parametric
+from fks_tpu.parallel import make_population_eval
+from fks_tpu.sim.engine import SimConfig
+wl = synthetic_workload(1000, 100_000, seed=0)
+cfg = SimConfig(track_ctime=False)
+pop = 8
+params = parametric.init_population(jax.random.PRNGKey(0), pop, noise=0.1)
+ev = make_population_eval(wl, cfg=cfg, engine="flat")
+t0 = time.perf_counter()
+res = ev(params); jax.block_until_ready(res.policy_score)
+compile_s = time.perf_counter() - t0
+t0 = time.perf_counter()
+res = ev(params); jax.block_until_ready(res.policy_score)
+best = time.perf_counter() - t0
+print(json.dumps({"nodes": 1000, "pods": 100000, "pop": pop,
+                  "compile_s": round(compile_s, 1), "best_s": round(best, 2),
+                  "evals_per_sec": round(pop / best, 3)}))
+"""),
 }
 
-ORDER = ["probe", "flat", "fused64", "gate", "fused256", "tiers", "scale"]
+ORDER = ["probe", "flat", "fused64", "gate", "fused256", "tiers", "scale",
+         "scale100k"]
 
 
 def main():
