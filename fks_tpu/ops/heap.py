@@ -26,6 +26,13 @@ cost O(log n) elements of HBM traffic per event and batch cleanly under
 ``vmap`` (a lane-masked op is a dropped scatter, not a full-array select).
 This is what makes the engine's event loop a lean ``lax.while_loop`` body
 (SURVEY.md §7 "hard parts": 2.5M scan-steps/s/chip budget).
+
+Storage layout (round 3): the four per-item fields live as COLUMNS of one
+``i32[cap, 4]`` matrix, so every heap mutation is a single row-gather plus
+a single row-scatter instruction instead of four of each. On TPU,
+per-lane-indexed gathers/scatters in a vmapped loop body cost serialized
+latency PER INSTRUCTION (~35 us each, tools/probe_ops.py / PROFILE.md), so
+instruction count -- not bytes -- is the price; rows cut it 4x.
 """
 from __future__ import annotations
 
@@ -39,24 +46,46 @@ import numpy as np
 KIND_CREATE = 0
 KIND_DELETE = 1
 
+# column indices of EventHeap.data
+COL_TIME, COL_RANK, COL_KIND, COL_POD = 0, 1, 2, 3
+
 
 class EventHeap(NamedTuple):
-    """Array-backed binary min-heap of scheduling events."""
+    """Array-backed binary min-heap of scheduling events.
 
-    time: jax.Array  # i32[cap]
-    rank: jax.Array  # i32[cap] pod-id tie rank (secondary key)
-    kind: jax.Array  # i8[cap] 0=CREATE 1=DELETE
-    pod: jax.Array  # i32[cap] pod index
+    ``data[i] == (time, rank, kind, pod)`` of heap slot ``i``; ``size`` is
+    the live element count. The ``time``/``rank``/``kind``/``pod``
+    properties are column views for read paths (tests, the engine's
+    pending-deletion scans); mutation always goes through row ops.
+    """
+
+    data: jax.Array  # i32[cap, 4]
     size: jax.Array  # i32[] live element count
 
     @property
     def capacity(self) -> int:
-        return self.time.shape[0]
+        return self.data.shape[0]
 
     @property
     def levels(self) -> int:
         """Max root-to-leaf path length: ceil(log2(cap)) + 1."""
         return max(1, int(np.ceil(np.log2(max(self.capacity, 2)))) + 1)
+
+    @property
+    def time(self):
+        return self.data[..., COL_TIME]
+
+    @property
+    def rank(self):
+        return self.data[..., COL_RANK]
+
+    @property
+    def kind(self):
+        return self.data[..., COL_KIND]
+
+    @property
+    def pod(self):
+        return self.data[..., COL_POD]
 
 
 def _less(ta, ra, tb, rb):
@@ -79,33 +108,25 @@ def heap_from_events(times, ranks, kinds, pods, capacity: int | None = None) -> 
     cap = capacity or n
     if cap < n:
         raise ValueError(f"heap capacity {cap} < {n}")
-    arr = np.zeros((4, cap), dtype=np.int64)
+    arr = np.zeros((cap, 4), dtype=np.int64)
     if n:
-        arr[:, :n] = np.array(items, dtype=np.int64).T
-    return EventHeap(
-        time=jnp.asarray(arr[0], jnp.int32),
-        rank=jnp.asarray(arr[1], jnp.int32),
-        kind=jnp.asarray(arr[2], jnp.int8),
-        pod=jnp.asarray(arr[3], jnp.int32),
-        size=jnp.asarray(n, jnp.int32),
-    )
+        arr[:n, :] = np.array(items, dtype=np.int64)
+    return EventHeap(data=jnp.asarray(arr, jnp.int32),
+                     size=jnp.asarray(n, jnp.int32))
 
 
-def _gather(h: EventHeap, idx):
-    """Clamped gather of items at ``idx`` (any shape)."""
+def _rows(h: EventHeap, idx):
+    """Clamped row-gather of items at ``idx`` (any shape): one instruction.
+    Returns ``[..., 4]`` rows."""
     i = jnp.clip(idx, 0, h.capacity - 1)
-    return h.time[i], h.rank[i], h.kind[i], h.pod[i]
+    return h.data[i]
 
 
-def _scatter(h: EventHeap, idx, t, r, k, p, new_size) -> EventHeap:
-    """Duplicate-free drop-mode scatter of items; indices == cap are dropped."""
-    return EventHeap(
-        time=h.time.at[idx].set(t, mode="drop"),
-        rank=h.rank.at[idx].set(r, mode="drop"),
-        kind=h.kind.at[idx].set(k.astype(jnp.int8), mode="drop"),
-        pod=h.pod.at[idx].set(p, mode="drop"),
-        size=new_size,
-    )
+def _scatter_rows(h: EventHeap, idx, rows, new_size) -> EventHeap:
+    """Duplicate-free drop-mode row scatter; indices == cap are dropped.
+    One instruction for all four fields."""
+    return EventHeap(data=h.data.at[idx].set(rows, mode="drop"),
+                     size=new_size)
 
 
 def heap_push(h: EventHeap, time, rank, kind, pod, pred=True) -> EventHeap:
@@ -123,7 +144,7 @@ def heap_push(h: EventHeap, time, rank, kind, pod, pred=True) -> EventHeap:
     pos = h.size
     xt = jnp.asarray(time, jnp.int32)
     xr = jnp.asarray(rank, jnp.int32)
-    xk = jnp.asarray(kind, jnp.int8)
+    xk = jnp.asarray(kind, jnp.int32)
     xp = jnp.asarray(pod, jnp.int32)
     pred = jnp.asarray(pred, bool)
 
@@ -138,7 +159,8 @@ def heap_push(h: EventHeap, time, rank, kind, pod, pred=True) -> EventHeap:
     shift = jnp.clip(e - ks, 0, 31)
     q = (pos1 >> shift) - 1  # [L]; q_e == pos for k == e
     valid = ks < e
-    vt, vr, vk, vp = _gather(h, q)
+    v = _rows(h, q)  # [L, 4]
+    vt, vr = v[:, COL_TIME], v[:, COL_RANK]
 
     # insertion depth: ancestors with key <= newitem stay above it
     s = jnp.sum((valid & ~_less(xt, xr, vt, vr)).astype(jnp.int32))
@@ -153,12 +175,10 @@ def heap_push(h: EventHeap, time, rank, kind, pod, pred=True) -> EventHeap:
     x_tgt = jnp.where(pred, q[jnp.minimum(s, L - 1)], cap)
 
     idx = jnp.concatenate([tgt, x_tgt[None]])
-    t_all = jnp.concatenate([vt, xt[None]])
-    r_all = jnp.concatenate([vr, xr[None]])
-    k_all = jnp.concatenate([vk, xk[None]])
-    p_all = jnp.concatenate([vp, xp[None]])
+    x_row = jnp.stack([xt, xr, xk, xp])
+    rows = jnp.concatenate([v, x_row[None, :]], axis=0)  # [L+1, 4]
     new_size = h.size + pred.astype(jnp.int32)
-    return _scatter(h, idx, t_all, r_all, k_all, p_all, new_size)
+    return _scatter_rows(h, idx, rows, new_size)
 
 
 def heap_pop(h: EventHeap, pred=True):
@@ -172,43 +192,41 @@ def heap_pop(h: EventHeap, pred=True):
     shift up one level, items below stay put. The descent carries only a
     scalar position (unrolled, fixed depth); the mutation is one scatter.
 
-    Caller must ensure size > 0 when ``pred`` holds. Returns (heap, item).
+    Caller must ensure size > 0 when ``pred`` holds. Returns (heap, item)
+    with item = (time, rank, kind, pod) scalars.
     """
     L = h.levels
     cap = jnp.int32(h.capacity)
-    item = _gather(h, jnp.int32(0))
     newsize = jnp.maximum(h.size - 1, 0)
-    xt, xr, xk, xp = _gather(h, newsize)  # relocated last element
+    head_last = _rows(h, jnp.stack([jnp.int32(0), newsize]))  # [2, 4]
+    item = (head_last[0, COL_TIME], head_last[0, COL_RANK],
+            head_last[0, COL_KIND], head_last[0, COL_POD])
+    x = head_last[1]  # relocated last element
+    xt, xr = x[COL_TIME], x[COL_RANK]
 
-    # smaller-child descent from the root among live slots [0, newsize)
-    qs, vts, vrs, vks, vps, alive_ks = [], [], [], [], [], []
+    # smaller-child descent from the root among live slots [0, newsize):
+    # one [2, 4] row-gather per level (child + right sibling)
+    qs, vrows, alive_ks = [], [], []
     pos = jnp.int32(0)
     alive = jnp.bool_(True)
     for _ in range(1, L):
         child = 2 * pos + 1
         right = child + 1
-        ct, cr, ck, cp = _gather(h, child)
-        rt, rr, rk, rp = _gather(h, right)
+        pair = _rows(h, jnp.stack([child, right]))  # [2, 4]
+        ct, cr = pair[0, COL_TIME], pair[0, COL_RANK]
+        rt, rr = pair[1, COL_TIME], pair[1, COL_RANK]
         use_right = (right < newsize) & ~_less(ct, cr, rt, rr)
         cpos = jnp.where(use_right, right, child)
         alive = alive & (child < newsize)
-        vt = jnp.where(use_right, rt, ct)
-        vr = jnp.where(use_right, rr, cr)
-        vk = jnp.where(use_right, rk, ck)
-        vp = jnp.where(use_right, rp, cp)
+        vrow = jnp.where(use_right, pair[1], pair[0])  # [4]
         qs.append(cpos)
-        vts.append(vt)
-        vrs.append(vr)
-        vks.append(vk)
-        vps.append(vp)
+        vrows.append(vrow)
         alive_ks.append(alive)
         pos = jnp.where(alive, cpos, pos)
 
     q = jnp.stack(qs)  # [L-1] path slots q_1..q_{L-1}
-    vt = jnp.stack(vts)
-    vr = jnp.stack(vrs)
-    vk = jnp.stack(vks)
-    vp = jnp.stack(vps)
+    v = jnp.stack(vrows)  # [L-1, 4]
+    vt, vr = v[:, COL_TIME], v[:, COL_RANK]
     valid = jnp.stack(alive_ks)  # k <= d (live path levels)
 
     # insertion depth s = #{live v_k <= x}; chain ascending => suffix moves
@@ -224,12 +242,9 @@ def heap_pop(h: EventHeap, pred=True):
         pred, jnp.where(s > 0, q[jnp.clip(s - 1, 0, L - 2)], 0), cap)
 
     idx = jnp.concatenate([tgt, x_tgt[None]])
-    t_all = jnp.concatenate([vt, xt[None]])
-    r_all = jnp.concatenate([vr, xr[None]])
-    k_all = jnp.concatenate([vk, xk[None]])
-    p_all = jnp.concatenate([vp, xp[None]])
+    rows = jnp.concatenate([v, x[None, :]], axis=0)  # [L, 4]
     new_size = jnp.where(pred, newsize, h.size)
-    h2 = _scatter(h, idx, t_all, r_all, k_all, p_all, new_size)
+    h2 = _scatter_rows(h, idx, rows, new_size)
     return h2, item
 
 
@@ -238,7 +253,8 @@ def first_deletion_in_array_order(h: EventHeap):
     the first DELETION in raw backing-array order. Returns (found, time)."""
     cap = h.capacity
     idx = jnp.arange(cap, dtype=jnp.int32)
-    is_del = (h.kind == KIND_DELETE) & (idx < h.size)
+    kind = h.data[:, COL_KIND]
+    is_del = (kind == KIND_DELETE) & (idx < h.size)
     pos = jnp.argmax(is_del)  # first True in array order
     found = is_del[pos]
-    return found, h.time[pos]
+    return found, h.data[pos, COL_TIME]

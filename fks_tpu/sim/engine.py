@@ -101,16 +101,19 @@ def initial_state(workload: Workload, cfg: SimConfig) -> SimState:
             f"wait_hist_size {hist_size} <= trace max gpu_milli; "
             "fragmentation min_needed would be miscounted")
     f = cfg.score_dtype
+    pod_state = jnp.stack([
+        jnp.full(pp, -1, jnp.int32),                     # assigned node
+        jnp.zeros(pp, jnp.int32),                        # gpu bitmask
+        jnp.asarray(p.creation_time, jnp.int32),         # pod_ctime
+        jnp.zeros(pp, jnp.int32),                        # waiting flag
+    ], axis=-1)
     return SimState(
         heap=heap,
         cpu_left=jnp.asarray(c.cpu_total, jnp.int32),
         mem_left=jnp.asarray(c.mem_total, jnp.int32),
         gpu_left=jnp.asarray(c.gpu_declared, jnp.int32),
         gpu_milli_left=jnp.asarray(c.gpu_milli_total, jnp.int32),
-        assigned_node=jnp.full(pp, -1, jnp.int32),
-        assigned_gpus=jnp.zeros(pp, jnp.uint32),
-        pod_ctime=jnp.asarray(p.creation_time, jnp.int32),
-        waiting=jnp.zeros(pp, bool),
+        pod_state=pod_state,
         wait_hist=jnp.zeros(hist_size, jnp.int32),
         events_processed=jnp.int32(0),
         snap_idx=jnp.int32(0),
@@ -182,35 +185,45 @@ def build_step(workload: Workload, policy: PolicyFn, cfg: SimConfig,
     g_iota = jnp.arange(g, dtype=jnp.uint32)
     ktable = jnp.asarray(ktable, jnp.int32)
     klen = ktable.shape[0]
+    # pod features packed into one gather table so reading the popped
+    # pod's request costs a single row-gather (per-lane-indexed gathers
+    # cost serialized latency per INSTRUCTION under vmap; PROFILE.md).
+    # Padded 5 -> 8 columns: power-of-two rows keep the gather's slice
+    # aligned to the TPU lane tiling (same layout as flat.py's table).
+    feat = jnp.stack([p.cpu, p.mem, p.num_gpu, p.gpu_milli, p.duration,
+                      jnp.zeros_like(p.cpu), jnp.zeros_like(p.cpu),
+                      jnp.zeros_like(p.cpu)], axis=-1).astype(jnp.int32)
 
     def step(s: SimState) -> SimState:
         active = lane_active(s, max_steps)
         h, (t, rk, kind, pod) = heap_pop(s.heap, pred=active)
-        is_del = active & (kind == jnp.int8(KIND_DELETE))
-        create = active & ~(kind == jnp.int8(KIND_DELETE))
+        is_del = active & (kind == KIND_DELETE)
+        create = active & ~(kind == KIND_DELETE)
 
-        pcpu = p.cpu[pod]
-        pmem = p.mem[pod]
-        pngpu = p.num_gpu[pod]
-        pmilli = p.gpu_milli[pod]
-        pdur = p.duration[pod]
+        pf = feat[pod]  # [8], one gather
+        pcpu, pmem, pngpu, pmilli, pdur = pf[0], pf[1], pf[2], pf[3], pf[4]
+        ps_row = s.pod_state[pod]  # [4], one gather
+        held_node = ps_row[SimState.COL_NODE]
+        bits = jax.lax.bitcast_convert_type(
+            ps_row[SimState.COL_BITS], jnp.uint32)
+        pod_ct = ps_row[SimState.COL_CTIME]
+        was_waiting = ps_row[SimState.COL_WAIT] != 0
 
         # ---- DELETION: refund resources (reference main.py:74-99).
         # Dense one-hot adds over the tiny node axis, not scatters — TPU
         # scatters serialize per element (PROFILE.md).
-        a = jnp.where(is_del, s.assigned_node[pod], 0)
+        a = jnp.where(is_del, held_node, 0)
         di = is_del.astype(jnp.int32)
         n_iota = jnp.arange(n, dtype=jnp.int32)
         oh_a = (n_iota == a).astype(jnp.int32) * di  # [N]
         cpu_left = s.cpu_left + oh_a * pcpu
         mem_left = s.mem_left + oh_a * pmem
         gpu_left = s.gpu_left + oh_a * pngpu
-        bits = s.assigned_gpus[pod]
         sel_bits = ((bits >> g_iota) & 1).astype(jnp.int32)  # [G]
         gpu_milli_left = s.gpu_milli_left + oh_a[:, None] * pmilli * sel_bits[None, :]
 
         # ---- CREATION: score every node, strict argmax (main.py:101-111)
-        pod_view = PodView(pcpu, pmem, pngpu, pmilli, s.pod_ctime[pod], pdur)
+        pod_view = PodView(pcpu, pmem, pngpu, pmilli, pod_ct, pdur)
         node_view = _node_view(c, cpu_left, mem_left, gpu_left, gpu_milli_left)
         if cfg.cond_policy:
             out = jax.eval_shape(policy, pod_view, node_view)
@@ -235,23 +248,18 @@ def build_step(workload: Workload, policy: PolicyFn, cfg: SimConfig,
         gpu_milli_left = gpu_milli_left - (
             oh_b[:, None] * pmilli * sel.astype(jnp.int32)[None, :])
 
-        was_waiting = s.waiting[pod]
-        assigned_node = s.assigned_node.at[pod].set(
-            jnp.where(pl, b, s.assigned_node[pod]))
         new_bits = jnp.sum(jnp.where(sel, jnp.uint32(1) << g_iota, jnp.uint32(0)),
                            dtype=jnp.uint32)
-        assigned_gpus = s.assigned_gpus.at[pod].set(
-            jnp.where(pl, new_bits, bits))
-        heap2 = heap_push(h, t + pdur, rk, KIND_DELETE, pod, pred=pl)
 
         # ---- failed creation: waiting set + fragmentation + retry
         # (main.py:113-123, evaluator.py:69-75,144-163, event_simulator.py:51-58)
         failp = create & ~placed
         bucket = jnp.clip(pmilli, 0, s.wait_hist.shape[0] - 1)
-        hist = s.wait_hist.at[bucket].add(
-            (failp & ~was_waiting & (pngpu > 0)).astype(jnp.int32)
-            - (pl & was_waiting & (pngpu > 0)).astype(jnp.int32))
-        waiting = s.waiting.at[pod].set((was_waiting | failp) & ~pl)
+        hdelta = ((failp & ~was_waiting & (pngpu > 0)).astype(jnp.int32)
+                  - (pl & was_waiting & (pngpu > 0)).astype(jnp.int32))
+        # dense one-hot blend over the small histogram axis, not a scatter
+        h_iota = jnp.arange(s.wait_hist.shape[0], dtype=jnp.int32)
+        hist = s.wait_hist + (h_iota == bucket).astype(jnp.int32) * hdelta
 
         hvals = hist > 0
         has_gpu_waiting = jnp.any(hvals)
@@ -267,12 +275,29 @@ def build_step(workload: Workload, policy: PolicyFn, cfg: SimConfig,
         frag_sum = s.frag_sum + jnp.where(failp, frag_score, 0)
         frag_count = s.frag_count + failp.astype(jnp.int32)
 
-        found, dt = first_deletion_in_array_order(heap2)
+        found, dt = first_deletion_in_array_order(h)
         retry = failp & found
         rt = dt + 1
-        pod_ctime = s.pod_ctime.at[pod].set(
-            jnp.where(retry, rt, s.pod_ctime[pod]))
-        heap3 = heap_push(heap2, rt, rk, KIND_CREATE, pod, pred=retry)
+        # ONE merged push serves both outcomes — they are mutually
+        # exclusive (pl => placed; retry => not placed): DELETE at t+dur
+        # when placed, retried CREATE at rt on a failed placement with a
+        # pending deletion. Scanning ``h`` (the post-pop heap) is exactly
+        # the reference's scan point: when its repush scans, no DELETE
+        # was pushed for this event (the pod was not placed), so the
+        # pre-delete-push and post-delete-push heaps are identical.
+        heap3 = heap_push(
+            h, jnp.where(pl, t + pdur, rt), rk,
+            jnp.where(pl, KIND_DELETE, KIND_CREATE), pod, pred=pl | retry)
+
+        # ---- pod bookkeeping: ONE row scatter updates assignment, GPU
+        # bits, retry-mutated creation time, and waiting-set membership
+        new_row = jnp.stack([
+            jnp.where(pl, b, held_node),
+            jax.lax.bitcast_convert_type(
+                jnp.where(pl, new_bits, bits), jnp.int32),
+            jnp.where(retry, rt, pod_ct),
+            ((was_waiting | failp) & ~pl).astype(jnp.int32)])
+        pod_state = s.pod_state.at[pod].set(new_row)
 
         # ---- evaluator bookkeeping (main.py:63-72, evaluator.py:55-67).
         # On alloc_fail the reference raises BEFORE record_event_processed.
@@ -300,18 +325,19 @@ def build_step(workload: Workload, policy: PolicyFn, cfg: SimConfig,
         violations = s.violations
         if cfg.validate_invariants:
             hi = jnp.arange(heap3.pod.shape[0])
-            pend_del = (hi < heap3.size) & (heap3.kind == jnp.int8(KIND_DELETE))
+            pend_del = (hi < heap3.size) & (heap3.kind == KIND_DELETE)
             active_pods = jnp.zeros(
-                s.assigned_node.shape[0], bool).at[heap3.pod].max(pend_del)
+                pod_state.shape[0], bool).at[heap3.pod].max(pend_del)
             violations = violations + active.astype(jnp.int32) * _audit(
                 c, p, active_pods, cpu_left, mem_left, gpu_left,
-                gpu_milli_left, assigned_node, assigned_gpus)
+                gpu_milli_left, pod_state[:, SimState.COL_NODE],
+                jax.lax.bitcast_convert_type(
+                    pod_state[:, SimState.COL_BITS], jnp.uint32))
 
         return SimState(
             heap=heap3, cpu_left=cpu_left, mem_left=mem_left,
             gpu_left=gpu_left, gpu_milli_left=gpu_milli_left,
-            assigned_node=assigned_node, assigned_gpus=assigned_gpus,
-            pod_ctime=pod_ctime, waiting=waiting, wait_hist=hist,
+            pod_state=pod_state, wait_hist=hist,
             events_processed=events, snap_idx=snap_idx, snap_sums=snap_sums,
             frag_sum=frag_sum, frag_count=frag_count, max_nodes=max_nodes,
             failed=s.failed | alloc_fail, steps=s.steps + active.astype(jnp.int32),

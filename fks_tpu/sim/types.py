@@ -10,6 +10,9 @@ from __future__ import annotations
 
 from typing import Any, Callable, NamedTuple
 
+import jax.lax
+import jax.numpy as jnp
+
 from fks_tpu.ops.heap import EventHeap
 
 
@@ -52,7 +55,17 @@ PolicyFn = Callable[[PodView, NodeView], Any]  # -> i32[N]
 
 
 class SimState(NamedTuple):
-    """The lax.while_loop carry: complete simulation + evaluator state."""
+    """The lax.while_loop carry: complete simulation + evaluator state.
+
+    Per-pod scheduling state (reference Pod.assigned_* + waiting-set
+    membership + retry-mutated creation time, entities.py:42-43,
+    main.py:43, event_simulator.py:56) lives as COLUMNS of one
+    ``i32[P, 4]`` matrix so each event's read and write are single
+    row-gather/row-scatter instructions — per-lane-indexed scatters under
+    vmap cost serialized latency per INSTRUCTION on TPU (PROFILE.md), so
+    four separate arrays cost 4x. Columns: (assigned_node, gpu bitmask
+    bit-cast to i32, pod_ctime, waiting flag). Use the ``assigned_node``/
+    ``assigned_gpus``/``pod_ctime``/``waiting`` properties to read."""
 
     heap: EventHeap
     # cluster (reference Node/GPU mutable fields)
@@ -60,11 +73,7 @@ class SimState(NamedTuple):
     mem_left: Any  # i32[N]
     gpu_left: Any  # i32[N]
     gpu_milli_left: Any  # i32[N, G]
-    # pod scheduling state (reference Pod.assigned_*, entities.py:42-43)
-    assigned_node: Any  # i32[P], -1 = unassigned
-    assigned_gpus: Any  # u32[P] bitmask over G
-    pod_ctime: Any  # i32[P] creation_time (mutated on retry)
-    waiting: Any  # bool[P] membership of waiting_pods (main.py:43)
+    pod_state: Any  # i32[P, 4] (see class docstring)
     wait_hist: Any  # i32[M] histogram of gpu_milli of waiting GPU pods
     # evaluator accumulators (reference SchedulingEvaluator)
     events_processed: Any  # i32
@@ -77,6 +86,29 @@ class SimState(NamedTuple):
     failed: Any  # bool: GPU allocation raised in the reference -> abort
     steps: Any  # i32
     violations: Any  # i32: invariant-audit failures (0 unless enabled)
+
+    # pod_state column indices
+    COL_NODE = 0
+    COL_BITS = 1
+    COL_CTIME = 2
+    COL_WAIT = 3
+
+    @property
+    def assigned_node(self):  # i32[P], -1 = unassigned
+        return self.pod_state[..., SimState.COL_NODE]
+
+    @property
+    def assigned_gpus(self):  # u32[P] bitmask over G
+        return jax.lax.bitcast_convert_type(
+            self.pod_state[..., SimState.COL_BITS], jnp.uint32)
+
+    @property
+    def pod_ctime(self):  # i32[P] creation_time (mutated on retry)
+        return self.pod_state[..., SimState.COL_CTIME]
+
+    @property
+    def waiting(self):  # bool[P] waiting_pods membership (main.py:43)
+        return self.pod_state[..., SimState.COL_WAIT] != 0
 
 
 class FlatState(NamedTuple):

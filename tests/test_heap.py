@@ -93,10 +93,7 @@ def test_equal_time_orders_by_rank():
 @pytest.mark.slow
 def test_vmapped_heap_ops():
     def trace(times):
-        h = EventHeap(
-            time=jnp.zeros(8, jnp.int32), rank=jnp.zeros(8, jnp.int32),
-            kind=jnp.zeros(8, jnp.int8), pod=jnp.zeros(8, jnp.int32),
-            size=jnp.int32(0))
+        h = EventHeap(data=jnp.zeros((8, 4), jnp.int32), size=jnp.int32(0))
         for i in range(4):
             h = heap_push(h, times[i], jnp.int32(i), jnp.int8(0), jnp.int32(i))
         out = []

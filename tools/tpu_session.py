@@ -155,54 +155,38 @@ sys.stderr.write(r.stderr[-2000:])
 print(r.stdout.strip().splitlines()[-1] if r.stdout.strip() else "{{}}")
 sys.exit(r.returncode)
 """),
-    "scale": (900, """
-import json, time
-import jax, numpy as np
-from fks_tpu.data.synthetic import synthetic_workload
-from fks_tpu.models import parametric
-from fks_tpu.parallel import make_population_eval
-from fks_tpu.sim.engine import SimConfig
-wl = synthetic_workload(1000, 20000, seed=0)
-cfg = SimConfig(track_ctime=False)
-pop = 8
-params = parametric.init_population(jax.random.PRNGKey(0), pop, noise=0.1)
-ev = make_population_eval(wl, cfg=cfg, engine="flat")
-t0 = time.perf_counter()
-res = ev(params); jax.block_until_ready(res.policy_score)
-compile_s = time.perf_counter() - t0
-t0 = time.perf_counter()
-res = ev(params); jax.block_until_ready(res.policy_score)
-best = time.perf_counter() - t0
-print(json.dumps({"nodes": 1000, "pods": 20000, "pop": pop,
-                  "compile_s": round(compile_s, 1), "best_s": round(best, 2),
-                  "evals_per_sec": round(pop / best, 3)}))
-"""),
-    # BASELINE config 5's trace-length axis on one chip (the mesh spreads
-    # population, not the sequential event scan, so per-chip cost is the
-    # number that matters; round-2 verdict ask #6)
-    "scale100k": (1800, """
-import json, time
-import jax, numpy as np
-from fks_tpu.data.synthetic import synthetic_workload
-from fks_tpu.models import parametric
-from fks_tpu.parallel import make_population_eval
-from fks_tpu.sim.engine import SimConfig
-wl = synthetic_workload(1000, 100_000, seed=0)
-cfg = SimConfig(track_ctime=False)
-pop = 8
-params = parametric.init_population(jax.random.PRNGKey(0), pop, noise=0.1)
-ev = make_population_eval(wl, cfg=cfg, engine="flat")
-t0 = time.perf_counter()
-res = ev(params); jax.block_until_ready(res.policy_score)
-compile_s = time.perf_counter() - t0
-t0 = time.perf_counter()
-res = ev(params); jax.block_until_ready(res.policy_score)
-best = time.perf_counter() - t0
-print(json.dumps({"nodes": 1000, "pods": 100000, "pop": pop,
-                  "compile_s": round(compile_s, 1), "best_s": round(best, 2),
-                  "evals_per_sec": round(pop / best, 3)}))
-"""),
 }
+
+# synthetic-scale stages share one script template (nodes, pods, pop).
+# scale100k is BASELINE config 5's trace-length axis on one chip — the
+# mesh spreads population, not the sequential event scan, so per-chip
+# cost is the number that matters (round-2 verdict ask #6).
+_SCALE_TEMPLATE = """
+import json, time
+import jax, numpy as np
+from fks_tpu.data.synthetic import synthetic_workload
+from fks_tpu.models import parametric
+from fks_tpu.parallel import make_population_eval
+from fks_tpu.sim.engine import SimConfig
+nodes, pods, pop = {nodes}, {pods}, {pop}
+wl = synthetic_workload(nodes, pods, seed=0)
+cfg = SimConfig(track_ctime=False)
+params = parametric.init_population(jax.random.PRNGKey(0), pop, noise=0.1)
+ev = make_population_eval(wl, cfg=cfg, engine="flat")
+t0 = time.perf_counter()
+res = ev(params); jax.block_until_ready(res.policy_score)
+compile_s = time.perf_counter() - t0
+t0 = time.perf_counter()
+res = ev(params); jax.block_until_ready(res.policy_score)
+best = time.perf_counter() - t0
+print(json.dumps({{"nodes": nodes, "pods": pods, "pop": pop,
+                  "compile_s": round(compile_s, 1), "best_s": round(best, 2),
+                  "evals_per_sec": round(pop / best, 3)}}))
+"""
+
+STAGES["scale"] = (900, _SCALE_TEMPLATE.format(nodes=1000, pods=20000, pop=8))
+STAGES["scale100k"] = (
+    1800, _SCALE_TEMPLATE.format(nodes=1000, pods=100_000, pop=8))
 
 ORDER = ["probe", "flat", "fused64", "gate", "fused256", "tiers", "scale",
          "scale100k"]
