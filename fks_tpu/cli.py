@@ -27,10 +27,20 @@ import time
 def _apply_platform_flags(args):
     import jax
 
+    n_dev = getattr(args, "devices", 0)
+    if n_dev and not getattr(args, "cpu", False):
+        # fail loudly: silently falling back to one device is exactly the
+        # footgun --devices exists to prevent
+        raise SystemExit("--devices requires --cpu (it sizes the virtual "
+                         "CPU device mesh)")
     if getattr(args, "cpu", False):
         # jax.config, not JAX_PLATFORMS env: the env route hangs when the
         # TPU tunnel is wedged (see .claude/skills/verify/SKILL.md)
         jax.config.update("jax_platforms", "cpu")
+        if n_dev:
+            # must precede first backend init (same constraint as
+            # __graft_entry__.dryrun_multichip)
+            jax.config.update("jax_num_cpu_devices", n_dev)
     if getattr(args, "f64", False):
         jax.config.update("jax_enable_x64", True)
 
@@ -346,6 +356,11 @@ def main(argv=None) -> int:
     sc.add_argument("--pods-count", type=int, default=100000)
     sc.add_argument("--pop", type=int, default=8)
     sc.add_argument("--seed", type=int, default=0)
+    sc.add_argument("--devices", type=int, default=0,
+                    help="with --cpu: number of virtual CPU devices to "
+                         "mesh over (otherwise scale silently runs "
+                         "single-device vmap; this replaces setting "
+                         "XLA_FLAGS=--xla_force_host_platform_device_count)")
     sc.set_defaults(fn=cmd_scale)
 
     t = sub.add_parser("traces", help="list available trace files")

@@ -273,8 +273,10 @@ def _kernel(plan: _Plan, lanes: int,
             jnp.where(pod_gpu, ones, 0.0), node_has_gpu, best_fitf,
             gpu_imbalance, headroom,
         ], axis=-1)                                               # [L,N,F]
-        raw = jnp.einsum("lnf,lf->ln", feats, w_all,
-                         preferred_element_type=f32) * SCORE_SCALE
+        # explicit mul+reduce, NOT einsum: a batched dot_general (batch
+        # dim l) is a known Mosaic rejection class, while a VPU
+        # elementwise-multiply + small-axis reduce (F=16) always lowers
+        raw = jnp.sum(feats * w_all[:, None, :], axis=-1) * SCORE_SCALE
         feasible = (nmask_b
                     & (pcpu <= cpu_v) & (pmem <= mem_v) & (pngpu <= gpu_v)
                     & jnp.where(pod_gpu, eligible >= pngpu, True))

@@ -1,0 +1,35 @@
+#!/bin/bash
+# The full round-3 TPU evidence session, in priority order. Run the moment
+# the axon tunnel is healthy (probe: timeout 90 python -c "import jax;
+# print(jax.devices()[0].platform)"). Each piece appends to
+# benchmarks/results/round3_tpu.jsonl and survives a wedge mid-way —
+# re-running skips nothing but re-measures cheaply.
+#
+#   1. tpu_session.py: probe, flat-256 throughput (the headline), the
+#      first-ever Mosaic compile + gate + throughput of the fused kernel,
+#      VM/jit/parametric tier costs, scale rows (verdict asks #1b,#2,#5,#6)
+#   2. discover.py on-chip at pop 256 with exact re-score (verdict ask #4)
+#   3. bench.py itself, so the self-run JSON matches what the driver will
+#      record in BENCH_r03 (verdict ask #1)
+set -u
+cd "$(dirname "$0")/.."
+OUT=benchmarks/results/round3_tpu.jsonl
+
+python -u tools/tpu_session.py probe flat fused64 gate fused256 tiers 2>&1 |
+  tee -a benchmarks/results/round3_session.log
+
+# --resume only once a checkpoint exists, so a re-run after a mid-window
+# wedge continues the search instead of redoing finished generations
+CK=benchmarks/results/r3_discover_ck.npz
+RESUME=""
+[ -f "$CK" ] && RESUME="--resume"
+timeout 1500 python -u tools/discover.py --engine flat --gens 60 --pop 256 \
+  --seed 3 --out policies/discovered \
+  --checkpoint "$CK" $RESUME \
+  --metrics "$OUT" 2>&1 | tee -a benchmarks/results/round3_session.log
+
+python -u tools/tpu_session.py scale scale100k 2>&1 |
+  tee -a benchmarks/results/round3_session.log
+
+FKS_BENCH_DEADLINE_S=1000 timeout 1100 python bench.py \
+  2>benchmarks/results/round3_bench.stderr | tee -a "$OUT"
