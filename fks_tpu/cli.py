@@ -41,6 +41,20 @@ def _apply_platform_flags(args):
             # must precede first backend init (same constraint as
             # __graft_entry__.dryrun_multichip)
             jax.config.update("jax_num_cpu_devices", n_dev)
+            # n virtual device programs time-slicing few host cores skew
+            # their arrival at collectives far past XLA-CPU's default
+            # terminate timeout (observed: the 100k-pod mesh run died in
+            # rendezvous on a 1-core container until these were raised;
+            # README "Synthetic scale"). XLA_FLAGS is read at backend
+            # creation, so appending here is still in time.
+            import os
+            flags = os.environ.get("XLA_FLAGS", "")
+            for f in ("--xla_cpu_collective_timeout_seconds=7200",
+                      "--xla_cpu_collective_call_terminate_timeout_seconds"
+                      "=7200"):
+                if f.split("=")[0] not in flags:
+                    flags += " " + f
+            os.environ["XLA_FLAGS"] = flags.strip()
     if getattr(args, "f64", False):
         jax.config.update("jax_enable_x64", True)
 
@@ -275,7 +289,8 @@ def cmd_scale(args):
         meter = ThroughputMeter()
         meter.add(args.pop, t.seconds)
         out = {
-            "mode": mode, "nodes": wl.num_nodes, "pods": wl.num_pods,
+            "mode": mode, "engine": args.engine,
+            "nodes": wl.num_nodes, "pods": wl.num_pods,
             "population": args.pop, "wall_s": round(t.seconds, 3),
             "evals_per_sec": round(meter.rate, 3),
             "score_min": round(float(scores.min()), 4),
