@@ -129,6 +129,30 @@ class ParametricEvolution:
                      real_count=self.real_count, history=hist)
         return path
 
+    def init_from_weights(self, weights, noise: float, seed: int = 0) -> None:
+        """Seed the population around one weight vector: lane 0 holds it
+        exactly, the rest are Gaussian perturbations at ``noise`` scale.
+        Unlike ``restore_checkpoint`` (which demands an identical pop
+        size), this lets a NEW population geometry continue from a saved
+        champion. Preserves the mesh sharding and pad-lane masking
+        (``real_count`` is untouched)."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from fks_tpu.parallel.mesh import _pop_axes
+
+        champ = jnp.asarray(weights, self.params.dtype)
+        if champ.shape != tuple(self.params.shape[1:]):
+            raise ValueError(
+                f"champion weight vector has shape {tuple(champ.shape)}; "
+                f"this instance's parametric model expects "
+                f"{tuple(self.params.shape[1:])}")
+        key = jax.random.PRNGKey(seed)
+        perturbed = champ[None, :] + noise * jax.random.normal(
+            key, self.params.shape, self.params.dtype)
+        self.params = jax.device_put(
+            perturbed.at[0].set(champ),
+            NamedSharding(self.mesh, P(_pop_axes(self.mesh))))
+
     def restore_checkpoint(self, path: str) -> None:
         """Restore onto an instance built with the SAME workload/mesh/
         engine/pop_size; continuing reproduces the uninterrupted run

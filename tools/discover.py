@@ -40,8 +40,15 @@ def main():
     ap.add_argument("--checkpoint", default="")
     ap.add_argument("--resume", action="store_true",
                     help="restore from --checkpoint before searching")
+    ap.add_argument("--init-from", default="",
+                    help="champion JSON whose weights seed the population "
+                         "(lane 0 exact, others perturbed by --noise) — "
+                         "lets a NEW pop size continue a finished search, "
+                         "which --resume cannot (size must match)")
     ap.add_argument("--metrics", default="")
     args = ap.parse_args()
+    if args.resume and args.init_from:
+        ap.error("--resume and --init-from are mutually exclusive")
 
     import jax
     if args.cpu:
@@ -65,6 +72,18 @@ def main():
         pe.restore_checkpoint(args.checkpoint)
         print(f"resumed at generation {pe.generation} "
               f"(best {pe.best_score:.4f})", file=sys.stderr)
+    elif args.init_from:
+        with open(args.init_from) as f:
+            champ_doc = json.load(f)
+        if "weights" not in champ_doc:
+            print(f"error: {args.init_from} has no 'weights' field — it is "
+                  "a code-evolved champion (reference schema); --init-from "
+                  "needs a parametric champion", file=sys.stderr)
+            return 2
+        pe.init_from_weights(champ_doc["weights"], noise=args.noise,
+                             seed=args.seed + 7)
+        print(f"population seeded from {args.init_from} "
+              f"(pop {args.pop}, noise {args.noise})", file=sys.stderr)
     t0 = time.time()
 
     def on_gen(st):
