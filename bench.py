@@ -69,24 +69,45 @@ def log(*a):
 _RESULT_PRINTED = False
 
 
-def _fail(error: str) -> int:
+def _fallback_json(error: str) -> str:
     """The benchmark's single-JSON-line contract, error form. The note
     points at the most recent RECORDED device measurement (methodology in
     PROFILE.md / README) so an infrastructure failure — e.g. the axon
     tunnel wedging, observed to persist for hours — doesn't erase the
     round's evidence; the value stays 0.0 because this run measured
     nothing."""
-    global _RESULT_PRINTED
-    # flag set BEFORE the print: if a signal lands mid-print the handler
-    # must not try a second (reentrant) write on the same buffer
-    _RESULT_PRINTED = True
-    print(json.dumps({
+    return json.dumps({
         "metric": METRIC, "value": 0.0, "unit": "evals/s",
         "vs_baseline": 0.0, "error": error,
         "note": ("no live measurement this run; last recorded on-chip "
                  "result: flat engine 71.1 evals/s at pop 256 on the v5e "
                  "chip (tools/tpu_probe.py, 2026-07-31; see README "
-                 "'Measured performance' and PROFILE.md)")}), flush=True)
+                 "'Measured performance' and PROFILE.md)")})
+
+
+def _print_result(line: str) -> None:
+    """Print the result line with the handled kill signals BLOCKED, so
+    there is no window in which the flag and the print disagree: before
+    this call a kill writes the fallback, after it a kill writes nothing.
+    (Flag-before-print risked a half-written only record; flag-after-print
+    risked a 0.0 fallback line AFTER a complete success line, which the
+    take-last-parsable-line driver would prefer.)"""
+    global _RESULT_PRINTED
+    mask = {signal.SIGTERM, signal.SIGINT, signal.SIGHUP}
+    try:
+        old = signal.pthread_sigmask(signal.SIG_BLOCK, mask)
+    except (AttributeError, OSError, ValueError):  # non-main thread
+        old = None
+    try:
+        print(line, flush=True)
+        _RESULT_PRINTED = True
+    finally:
+        if old is not None:
+            signal.pthread_sigmask(signal.SIG_SETMASK, old)
+
+
+def _fail(error: str) -> int:
+    _print_result(_fallback_json(error))
     return 1
 
 
@@ -97,9 +118,16 @@ def _install_kill_writeahead():
     controller had no answer to an external kill."""
     def handler(signum, frame):  # noqa: ARG001
         if not _RESULT_PRINTED:
-            _fail(f"controller killed by signal {signum} "
-                  "before completion (outer timeout?)")
-        # plain exit, not os._exit: stdout is already flushed by _fail
+            # os.write, not print: the buffered stdout writer may be
+            # mid-write in the interrupted frame; a leading newline
+            # guarantees this record starts its own line
+            line = _fallback_json(
+                f"controller killed by signal {signum} "
+                "before completion (outer timeout?)")
+            try:
+                os.write(sys.stdout.fileno(), f"\n{line}\n".encode())
+            except OSError:
+                pass
         sys.exit(128 + signum)
     for sig in (signal.SIGTERM, signal.SIGINT, signal.SIGHUP):
         try:
@@ -387,14 +415,12 @@ def main():
             continue
     if evals_per_sec is None:
         return _fail("throughput stage produced no parsable result")
-    global _RESULT_PRINTED
-    _RESULT_PRINTED = True  # before the print; see _fail
-    print(json.dumps({
+    _print_result(json.dumps({
         "metric": METRIC,
         "value": round(evals_per_sec, 2),
         "unit": "evals/s",
         "vs_baseline": round(evals_per_sec / BASELINE_EVALS_PER_SEC, 3),
-    }), flush=True)
+    }))
     return 0
 
 

@@ -48,13 +48,32 @@ def _apply_platform_flags(args):
             # README "Synthetic scale"). XLA_FLAGS is read at backend
             # creation, so appending here is still in time.
             import os
-            flags = os.environ.get("XLA_FLAGS", "")
+            import sys
+            tokens = os.environ.get("XLA_FLAGS", "").split()
+            names = {t.split("=")[0] for t in tokens}
             for f in ("--xla_cpu_collective_timeout_seconds=7200",
                       "--xla_cpu_collective_call_terminate_timeout_seconds"
                       "=7200"):
-                if f.split("=")[0] not in flags:
-                    flags += " " + f
-            os.environ["XLA_FLAGS"] = flags.strip()
+                name = f.split("=")[0]
+                # token-boundary match, not substring: a user-set value for
+                # the SAME flag is honored (warn, since 40 s defaults hang
+                # the 100k-pod mesh run), and an unrelated flag sharing a
+                # prefix can't mask ours
+                if name in names:
+                    if f not in tokens:
+                        print(f"fks_tpu: honoring existing {name} from "
+                              "XLA_FLAGS", file=sys.stderr)
+                    continue
+                tokens.append(f)
+            try:  # private probe; best-effort warning only
+                initialized = bool(jax._src.xla_bridge._backends)
+            except AttributeError:
+                initialized = False
+            if initialized:  # appended too late to apply
+                print("fks_tpu: JAX backends already initialized; XLA_FLAGS "
+                      "collective timeouts will not take effect this run",
+                      file=sys.stderr)
+            os.environ["XLA_FLAGS"] = " ".join(tokens)
     if getattr(args, "f64", False):
         jax.config.update("jax_enable_x64", True)
 

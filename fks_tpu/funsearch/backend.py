@@ -72,7 +72,7 @@ class CodeEvaluator:
 
     def __init__(self, workload: Workload, cfg: SimConfig = SimConfig(),
                  max_workers: Optional[int] = None, use_vm: bool = True,
-                 engine: str = "exact"):
+                 engine: str = "exact", vm_batch: Optional[bool] = None):
         from fks_tpu.sim import get_engine
 
         self.workload = workload
@@ -87,6 +87,18 @@ class CodeEvaluator:
         self.max_workers = max_workers or min(8, os.cpu_count() or 1)
         self.use_vm = use_vm
         self._vm_run = None  # lazily built shared engine program
+        self._vm_pop_run = None  # lazily built POPULATION engine program
+        self.vm_batch_count = 0  # observability: batched VM launches
+        # Batched VM evaluation: under vmap the interpreter's lax.switch
+        # over a per-lane opcode executes ALL ~40 branches and selects.
+        # On TPU each branch is one elementwise vreg op — noise next to
+        # the engine step — so a generation as ONE launch wins; on a CPU
+        # host the same 40x op fan-out runs serially and loses badly to
+        # the sequential unbatched VM tier. Auto: batch iff the default
+        # backend is an accelerator.
+        if vm_batch is None:
+            vm_batch = jax.default_backend() != "cpu"
+        self.vm_batch = vm_batch
 
     # ----- VM tier: one engine program, candidates as data
 
@@ -113,6 +125,45 @@ class CodeEvaluator:
             self.vm_count += 1
         return self._vm_runner()(prog, self.state0)
 
+    # ----- batched VM tier: a GENERATION as one device program
+
+    def _vm_pop_runner(self):
+        if self._vm_pop_run is None:
+            # population semantics per SimConfig.cond_policy docs: under
+            # vmap a cond runs both branches, so keep cond_policy off and
+            # let the self-masking step skip nothing — the batch amortizes
+            self._vm_pop_run = jax.jit(self._mod.make_population_run_fn(
+                self.workload, vm.score_static, self.cfg))
+        return self._vm_pop_run
+
+    def _run_vm_batch(self, progs: List[vm.VMProgram]) -> List[SimResult]:
+        """Evaluate stacked VM candidates in ONE device launch.
+
+        Shapes are bucketed (capacity to the stack's power-of-two, the
+        population axis to the next power of two, padded by repeating the
+        last program) so the jitted population runner retraces only per
+        bucket, never per generation. Replaces the reference's
+        one-subprocess-per-candidate fan-out
+        (funsearch_integration.py:535-562) with one XLA program.
+        """
+        pop = max(1, 1 << (len(progs) - 1).bit_length())
+        padded = list(progs) + [progs[-1]] * (pop - len(progs))
+        stacked = vm.stack_programs(padded)
+        result = self._vm_pop_runner()(stacked, self.state0)
+        with self._lock:
+            self.vm_batch_count += 1
+            self.vm_count += len(progs)
+        return [jax.tree_util.tree_map(lambda x, i=i: x[i], result)
+                for i in range(len(progs))]
+
+    @staticmethod
+    def _record(code: str, result: SimResult) -> EvalRecord:
+        if bool(result.failed):
+            return EvalRecord(code, 0.0, "gpu allocation aborted", result)
+        if bool(result.truncated):
+            return EvalRecord(code, 0.0, "event budget exceeded", result)
+        return EvalRecord(code, float(result.policy_score), None, result)
+
     def _compiled(self, code: str):
         key = transpiler.canonical_key(code)
         with self._lock:
@@ -131,22 +182,20 @@ class CodeEvaluator:
                     self.compile_count += 1
         return fn
 
-    def evaluate_one(self, code: str) -> EvalRecord:
+    def evaluate_one(self, code: str, *,
+                     try_vm: Optional[bool] = None) -> EvalRecord:
         """Reference semantics: exceptions -> score 0 with the reason kept
-        (the reference loses the reason; we keep it for observability)."""
+        (the reference loses the reason; we keep it for observability).
+        ``try_vm=False`` skips the VM attempt (used by ``evaluate`` for
+        candidates already known to be outside the VM vocabulary)."""
         try:
             result: Optional[SimResult] = None
-            if self.use_vm:
+            if self.use_vm if try_vm is None else try_vm:
                 result = self._try_vm(code)
             if result is None:
                 run = self._compiled(code)
                 result = run(self.state0)
-            score = float(result.policy_score)
-            if bool(result.failed):
-                return EvalRecord(code, 0.0, "gpu allocation aborted", result)
-            if bool(result.truncated):
-                return EvalRecord(code, 0.0, "event budget exceeded", result)
-            return EvalRecord(code, score, None, result)
+            return self._record(code, result)
         except transpiler.TranspileError as e:
             return EvalRecord(code, 0.0, f"transpile: {e}")
         except Exception as e:  # noqa: BLE001 — candidate code is untrusted
@@ -155,12 +204,15 @@ class CodeEvaluator:
     def evaluate(self, codes: Sequence[str]) -> List[EvalRecord]:
         """Evaluate a batch; duplicate sources are computed once.
 
-        Unique candidates fan out over a thread pool: each candidate is a
-        distinct XLA program whose compile (the dominant cost, several
-        seconds each) runs in native code with the GIL released, so the
-        batch compiles concurrently while device executions interleave.
-        Result order — and therefore population admission order — matches
-        the input order regardless of completion order.
+        VM-vocabulary candidates (the common case) are lowered to register
+        programs on the host, STACKED, and evaluated as ONE device launch
+        (`_run_vm_batch`) — a generation of LLM candidates costs one
+        population-engine execution, zero per-candidate XLA compiles. The
+        rare candidate outside the VM vocabulary fans out over a thread
+        pool to the per-code jit tier, whose XLA compiles (native code, GIL
+        released) overlap each other. Result order — and therefore
+        population admission order — matches the input order regardless of
+        completion order.
         """
         keyed: List[Optional[str]] = []
         errors: Dict[int, EvalRecord] = {}
@@ -174,13 +226,59 @@ class CodeEvaluator:
         for key, code in zip(keyed, codes):
             if key is not None and key not in unique:
                 unique[key] = code
+
         memo: Dict[str, EvalRecord] = {}
-        if unique:
+        vm_progs: Dict[str, vm.VMProgram] = {}
+        jit_only: Dict[str, str] = {}  # known outside the VM vocabulary
+        general: Dict[str, str] = {}  # default tier choice (VM then jit)
+        c = self.workload.cluster
+        if self.use_vm and self.vm_batch and len(unique) > 1:
+            for key, code in unique.items():
+                try:
+                    prog = vm.compile_policy(code, c.n_padded, c.g_padded)
+                    if prog.capacity > self.VM_CAPACITY:
+                        raise vm.VMUnsupported(
+                            f"program too long: capacity {prog.capacity}")
+                    vm_progs[key] = prog
+                except vm.VMUnsupported:
+                    jit_only[key] = code
+                except transpiler.TranspileError as e:
+                    memo[key] = EvalRecord(code, 0.0, f"transpile: {e}")
+                except Exception as e:  # noqa: BLE001 — untrusted code
+                    memo[key] = EvalRecord(code, 0.0, f"runtime: {e}")
+            if len(vm_progs) == 1:  # a population program for one lane
+                (key,) = vm_progs  # isn't worth it: unbatched VM tier
+                general[key] = unique[key]
+                vm_progs = {}
+        else:
+            general = dict(unique)
+
+        if vm_progs:
+            vm_keys = list(vm_progs)
+            try:
+                results = self._run_vm_batch([vm_progs[k] for k in vm_keys])
+                for key, res in zip(vm_keys, results):
+                    memo[key] = self._record(unique[key], res)
+            except Exception as e:  # noqa: BLE001 — batch failed:
+                # per-candidate fallback still produces scores, but say
+                # WHY the one-launch-per-generation path is not engaging
+                from fks_tpu.utils import get_logger
+                get_logger("fks_tpu.funsearch.backend").warning(
+                    "batched VM launch failed (%s: %s); falling back to "
+                    "per-candidate evaluation", type(e).__name__, e)
+                for key in vm_keys:
+                    general.setdefault(key, unique[key])
+
+        if jit_only or general:
             with concurrent.futures.ThreadPoolExecutor(
                     max_workers=self.max_workers) as ex:
-                futs = {key: ex.submit(self.evaluate_one, code)
-                        for key, code in unique.items()}
-                memo = {key: f.result() for key, f in futs.items()}
+                futs = {key: ex.submit(self.evaluate_one, code, try_vm=False)
+                        for key, code in jit_only.items()}
+                futs.update({key: ex.submit(self.evaluate_one, code)
+                             for key, code in general.items()})
+                for key, f in futs.items():
+                    memo[key] = f.result()
+
         out = []
         for i, (key, code) in enumerate(zip(keyed, codes)):
             if key is None:

@@ -214,10 +214,22 @@ class FunSearch:
             return 0.0
         if key in self._exact_memo:
             return self._exact_memo[key]
-        if self._exact_eval is None:
-            self._exact_eval = CodeEvaluator(
-                self.evaluator.workload, self.evaluator.cfg, engine="exact")
-        exact = self._exact_eval.evaluate_one(code).score
+        try:
+            if self._exact_eval is None:
+                self._exact_eval = CodeEvaluator(
+                    self.evaluator.workload, self.evaluator.cfg,
+                    engine="exact")
+            exact = self._exact_eval.evaluate_one(code).score
+        except Exception as e:  # noqa: BLE001 — the stated rule: a failed
+            # rescore maps to 0.0; it must never kill the evolve loop
+            # mid-generation (evaluate_one catches candidate failures, but
+            # evaluator construction itself can raise). NOT memoized: an
+            # infrastructure failure here is transient, and pinning the
+            # champion's exact fitness to 0.0 for the rest of the run
+            # would outlive it.
+            self.log(f"  exact rescore failed ({type(e).__name__}: {e}); "
+                     "fitness 0.0")
+            return 0.0
         self._exact_memo[key] = exact
         return exact
 

@@ -56,7 +56,8 @@ class OpenAIBackend:
 
     def __init__(self, api_key: str, base_url: str, model: str,
                  max_tokens: int = 500, temperature: float = 0.7,
-                 timeout: float = 60.0, max_retries: int = 2):
+                 timeout: float = 60.0, max_retries: int = 2,
+                 deadline: Optional[float] = None):
         self.api_key = api_key
         self.base_url = base_url.rstrip("/")
         self.model = model
@@ -64,6 +65,14 @@ class OpenAIBackend:
         self.temperature = temperature
         self.timeout = timeout
         self.max_retries = max_retries
+        # ``timeout`` is PER ATTEMPT; the overall bound on one complete()
+        # call is this deadline, enforced across retries + backoff so one
+        # hung endpoint holds a generation thread-pool slot for at most
+        # this long (default: the old worst case, attempts x timeout + the
+        # backoff sum, now explicit instead of implied)
+        self.deadline = deadline if deadline is not None else (
+            (max_retries + 1) * timeout
+            + sum(0.5 * (a + 1) for a in range(max_retries)))
 
     def complete(self, prompt: str) -> str:
         import json  # noqa: PLC0415 — keep module imports jax-light
@@ -81,10 +90,16 @@ class OpenAIBackend:
             f"{self.base_url}/chat/completions", data=body,
             headers={"Content-Type": "application/json",
                      "Authorization": f"Bearer {self.api_key}"})
-        last: Exception
+        last: Exception = TimeoutError(
+            f"deadline ({self.deadline:g}s) exhausted before any attempt")
+        t_end = time.monotonic() + self.deadline
         for attempt in range(self.max_retries + 1):
+            remaining = t_end - time.monotonic()
+            if remaining <= 0:
+                break  # overall deadline exhausted mid-retry
             try:
-                with urllib.request.urlopen(req, timeout=self.timeout) as r:
+                with urllib.request.urlopen(
+                        req, timeout=min(self.timeout, remaining)) as r:
                     resp = json.loads(r.read().decode())
                 return (resp["choices"][0]["message"]["content"] or "").strip()
             except urllib.error.HTTPError as e:
@@ -94,7 +109,8 @@ class OpenAIBackend:
             except (urllib.error.URLError, TimeoutError, OSError) as e:
                 last = e
             if attempt < self.max_retries:
-                time.sleep(0.5 * (attempt + 1))
+                time.sleep(min(0.5 * (attempt + 1),
+                               max(0.0, t_end - time.monotonic())))
         raise last
 
 

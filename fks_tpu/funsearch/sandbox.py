@@ -21,9 +21,12 @@ serves one of:
 from __future__ import annotations
 
 import ast
+import contextlib
 import dataclasses
 import math
 import operator
+import signal
+import threading
 from typing import Any, Optional, Sequence
 
 # ---------------------------------------------------------------- whitelists
@@ -197,6 +200,52 @@ class PolicyRuntimeError(RuntimeError):
     """Candidate code raised during scalar execution."""
 
 
+class PolicyTimeoutError(PolicyRuntimeError):
+    """Candidate code exceeded the scalar-execution deadline."""
+
+
+#: Wall-clock budget for one scalar candidate call. The whitelist admits
+#: ``range`` loops the transpiler has not yet bounded, so a validated
+#: candidate can still be a `for i in range(10**9)` bomb; the reference
+#: arms SIGALRM for the same reason (safe_execution.py:81-96).
+EXEC_TIMEOUT_S = 5.0
+
+
+@contextlib.contextmanager
+def _deadline(seconds: Optional[float]):
+    """SIGALRM-backed wall-clock guard around candidate execution.
+
+    Signals only arm in the main thread; elsewhere (e.g. the generation
+    thread pool) this is a no-op — safe there because the generator
+    transpiles BEFORE smoke-testing (llm.CandidateGenerator.generate), and
+    the transpiler's MAX_UNROLL bound rejects unbounded loops first. The
+    ordering is pinned by tests/test_funsearch_sandbox.py."""
+    if (not seconds
+            or threading.current_thread() is not threading.main_thread()):
+        yield
+        return
+
+    def _onalarm(signum, frame):
+        raise PolicyTimeoutError(
+            f"candidate exceeded the {seconds:g}s scalar deadline")
+
+    import time
+    old = signal.signal(signal.SIGALRM, _onalarm)
+    t0 = time.monotonic()
+    prev_delay, prev_interval = signal.setitimer(signal.ITIMER_REAL, seconds)
+    try:
+        yield
+    finally:
+        if prev_delay:  # re-arm an outer watchdog (minus our elapsed time)
+            signal.setitimer(
+                signal.ITIMER_REAL,
+                max(0.001, prev_delay - (time.monotonic() - t0)),
+                prev_interval)
+        else:
+            signal.setitimer(signal.ITIMER_REAL, 0.0)
+        signal.signal(signal.SIGALRM, old)
+
+
 def compile_policy(code: str, entry_point: str = "priority_function"):
     """Validate then compile candidate source once in the restricted
     environment; returns the scalar ``(pod, node) -> number`` callable
@@ -216,13 +265,19 @@ def compile_policy(code: str, entry_point: str = "priority_function"):
 
 
 def execute_scalar(code: str, pod: ScalarPod, node: ScalarNode,
-                   entry_point: str = "priority_function") -> float:
+                   entry_point: str = "priority_function",
+                   timeout_s: Optional[float] = EXEC_TIMEOUT_S) -> float:
     """One-shot validated scalar run returning a finite float (reference:
     safe_execution.py:126-168). Used for smoke tests and as the transpiler
-    differential-test oracle."""
+    differential-test oracle. A SIGALRM deadline (main thread only, see
+    ``_deadline``) fails a looping candidate fast instead of hanging the
+    host; ``timeout_s=None`` disables it."""
     fn = compile_policy(code, entry_point)
     try:
-        out = fn(pod, node)
+        with _deadline(timeout_s):
+            out = fn(pod, node)
+    except PolicyTimeoutError:
+        raise
     except Exception as e:
         raise PolicyRuntimeError(f"execution failed: {e}") from e
     if isinstance(out, bool) or not isinstance(out, (int, float)):

@@ -573,13 +573,8 @@ def _branches(n: int, g: int):
     ]
 
 
-def score(prog: VMProgram, pod: PodView, nodes: NodeView) -> jax.Array:
-    """Execute a lowered candidate -> i32 scores over the node axis.
-
-    The signature matches ``ParamPolicyFn`` with the program as the
-    parameter pytree, so every engine runner (plain, population, trace
-    batch, mesh) accepts VM candidates unchanged.
-    """
+def _execute(prog: VMProgram, pod: PodView, nodes: NodeView,
+             bound) -> jax.Array:
     n, g = nodes.gpu_mask.shape
     branches = _branches(n, g)
     inp = _inputs(pod, nodes)
@@ -592,7 +587,77 @@ def score(prog: VMProgram, pod: PodView, nodes: NodeView) -> jax.Array:
             regs[prog.a[k]], regs[prog.b[k]], regs[prog.c[k]], prog.imm[k])
         return lax.dynamic_update_index_in_dim(regs, res, N_INPUTS + k, 0)
 
-    regs = lax.fori_loop(0, prog.n_ops, body, regs)
+    regs = lax.fori_loop(0, bound, body, regs)
     out = regs[prog.out_reg][:, 0]
     # the policy's jaxpr already ends in an int cast; values are integral
     return out.astype(jnp.int32)
+
+
+def score(prog: VMProgram, pod: PodView, nodes: NodeView) -> jax.Array:
+    """Execute a lowered candidate -> i32 scores over the node axis.
+
+    The signature matches ``ParamPolicyFn`` with the program as the
+    parameter pytree, so every engine runner (plain, population, trace
+    batch, mesh) accepts VM candidates unchanged.
+    """
+    return _execute(prog, pod, nodes, prog.n_ops)
+
+
+def score_static(prog: VMProgram, pod: PodView, nodes: NodeView) -> jax.Array:
+    """`score` with a STATIC trip count (the padded capacity) — the
+    population-batched variant.
+
+    Under ``vmap`` the per-candidate ``n_ops`` is a batched loop bound, so
+    ``fori_loop`` would lower to a while_loop whose every iteration selects
+    the full [cap+N_INPUTS, N, G] register file per lane to freeze finished
+    lanes — far more HBM traffic than the ops themselves. Padding slots are
+    OP_NOPs (they copy register 0 into a fresh register the output never
+    reads), so running every lane to the static capacity is semantically
+    free and keeps the loop bound unbatched. Stack candidates with
+    ``stack_programs`` (which right-sizes the shared capacity) and pass this
+    as the ``param_policy`` of ``make_population_run_fn``.
+    """
+    return _execute(prog, pod, nodes, prog.capacity)
+
+
+def pad_capacity(prog: VMProgram, capacity: int) -> VMProgram:
+    """Re-pad a program's op arrays to ``capacity`` (NOP fill)."""
+    n_live = int(prog.n_ops)
+    if n_live > capacity:
+        raise VMUnsupported(f"program too long: {n_live} ops > {capacity}")
+    cur = prog.capacity
+    if cur == capacity:
+        return prog
+    if cur < capacity:
+        pad = capacity - cur
+
+        def ext(x, fill):
+            return jnp.concatenate(
+                [x, jnp.full((pad,), fill, x.dtype)])
+
+        return prog._replace(
+            opcode=ext(prog.opcode, OP_NOP), a=ext(prog.a, 0),
+            b=ext(prog.b, 0), c=ext(prog.c, 0), imm=ext(prog.imm, 0.0))
+    return prog._replace(
+        opcode=prog.opcode[:capacity], a=prog.a[:capacity],
+        b=prog.b[:capacity], c=prog.c[:capacity], imm=prog.imm[:capacity])
+
+
+def stack_programs(progs: Sequence[VMProgram],
+                   capacity: Optional[int] = None) -> VMProgram:
+    """Stack lowered candidates into ONE batched ``VMProgram`` pytree.
+
+    The shared capacity defaults to the smallest power of two covering the
+    longest member (min 32) so one compiled population-engine program
+    serves every batch of that bucket. This is the data half of the
+    population-batched code-candidate path: the reference evaluates a
+    generation by forking a subprocess per candidate (reference:
+    funsearch/funsearch_integration.py:535-562); here a generation is one
+    stacked pytree handed to one XLA program.
+    """
+    if not progs:
+        raise ValueError("stack_programs needs at least one program")
+    longest = max(int(p.n_ops) for p in progs)
+    cap = capacity or max(32, 1 << max(0, (longest - 1)).bit_length())
+    padded = [pad_capacity(p, cap) for p in progs]
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *padded)

@@ -35,6 +35,30 @@ import pytest  # noqa: E402
 FIXTURES = pathlib.Path(__file__).parent / "fixtures"
 
 
+def pytest_collection_modifyitems(config, items):
+    """Default to the fast tier by DESELECTING `slow` items — unless the
+    user passed -m (their marker expression wins) or named a test file
+    explicitly (running `pytest tests/test_differential.py`, an all-slow
+    module, means "run it", not "collect 0 tests and exit green" — the
+    footgun an addopts-level `-m "not slow"` default had)."""
+    if config.option.markexpr:
+        return
+    named = {
+        pathlib.Path(a.split("::")[0]).resolve()
+        for a in config.args if a.split("::")[0].endswith(".py")
+    }
+    selected, deselected = [], []
+    for item in items:
+        if ("slow" in item.keywords
+                and pathlib.Path(str(item.fspath)).resolve() not in named):
+            deselected.append(item)
+        else:
+            selected.append(item)
+    if deselected:
+        config.hook.pytest_deselected(items=deselected)
+        items[:] = selected
+
+
 @pytest.fixture(scope="session")
 def golden_default():
     with open(FIXTURES / "golden_default.json") as f:

@@ -76,3 +76,55 @@ def test_runtime_error_raises_policy_error():
     # PolicyRuntimeError, not crash the process
     with pytest.raises(sandbox.PolicyRuntimeError):
         sandbox.execute_scalar(code, pod, node)
+
+
+# --------------------------------------------------- execution deadline guard
+
+BOMB = template.fill_template(
+    "score = 0\n"
+    "    for i in range(1000000000):\n"
+    "        score = score + 1")
+
+
+def test_range_bomb_validates_but_times_out_in_bare_oracle():
+    """The whitelist admits the loop; the SIGALRM deadline must fail it
+    fast instead of hanging the host (reference safe_execution.py:81-96)."""
+    assert sandbox.validate(BOMB)
+    pod = sandbox.ScalarPod(1, 1, 0, 0)
+    node = sandbox.ScalarNode(1000, 1000, 1000, 1000, 0, ())
+    import time
+    t0 = time.monotonic()
+    with pytest.raises(sandbox.PolicyTimeoutError):
+        sandbox.execute_scalar(BOMB, pod, node, timeout_s=0.2)
+    assert time.monotonic() - t0 < 2.0
+
+
+def test_deadline_restores_signal_state():
+    import signal
+    old = signal.getsignal(signal.SIGALRM)
+    pod = sandbox.ScalarPod(1, 1, 0, 0)
+    node = sandbox.ScalarNode(1000, 1000, 1000, 1000, 0, ())
+    sandbox.execute_scalar(GOOD, pod, node, timeout_s=0.5)
+    assert signal.getsignal(signal.SIGALRM) == old
+
+
+def test_generator_transpiles_before_smoke(monkeypatch):
+    """The generation path's bomb defence is ordering: MAX_UNROLL rejection
+    at transpile happens BEFORE any scalar execution, so smoke_test must
+    never be reached for a range bomb (the thread-pooled generator cannot
+    arm SIGALRM)."""
+    from fks_tpu.funsearch import llm
+
+    def _boom(code):
+        raise AssertionError("smoke_test ran before transpile rejection")
+
+    monkeypatch.setattr(llm.sandbox, "smoke_test", _boom)
+
+    class _Bomb:
+        def complete(self, prompt):
+            return ("score = 0\n"
+                    "    for i in range(1000000000):\n"
+                    "        score = score + 1")
+
+    gen = llm.CandidateGenerator(_Bomb())
+    assert gen.generate([]) is None  # rejected at the transpile stage

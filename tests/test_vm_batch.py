@@ -1,0 +1,116 @@
+"""Population-batched VM evaluation (fks_tpu.funsearch.vm.stack_programs +
+backend._run_vm_batch). Contract: a stacked generation through ONE
+population-engine launch produces fitness identical to per-candidate
+evaluation, with zero per-candidate XLA compiles — the on-device
+counterpart of the reference's subprocess fan-out
+(funsearch/funsearch_integration.py:535-562)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fks_tpu.funsearch import backend, template, vm
+from tests.test_vm import _corpus, _rand_views, G, N
+
+
+def _micro_workload():
+    from fks_tpu.data.build import make_workload
+
+    nodes = [{"node_id": "n0", "cpu_milli": 4000, "memory_mib": 8000,
+              "gpus": [1000, 1000]},
+             {"node_id": "n1", "cpu_milli": 2000, "memory_mib": 4000,
+              "gpus": []}]
+    pods = [{"pod_id": f"p{i}", "cpu_milli": 500, "memory_mib": 500,
+             "num_gpu": i % 2, "gpu_milli": 300 * (i % 2),
+             "creation_time": i, "duration_time": 5} for i in range(6)]
+    return make_workload(nodes, pods, pad_nodes_to=2, pad_gpus_to=2,
+                         pad_pods_to=8)
+
+
+def test_pad_capacity_is_semantically_neutral():
+    """NOP padding never changes scores: score_static over the padded
+    capacity equals score over the live op count."""
+    rng = np.random.default_rng(11)
+    code = list(template.seed_policies().values())[0]
+    prog = vm.compile_policy(code, N, G)
+    padded = vm.pad_capacity(prog, 2 * prog.capacity)
+    assert padded.capacity == 2 * prog.capacity
+    for _ in range(3):
+        pod, nodes = _rand_views(rng)
+        np.testing.assert_array_equal(
+            np.asarray(vm.score(prog, pod, nodes)),
+            np.asarray(vm.score_static(padded, pod, nodes)))
+
+
+def test_stack_programs_shapes_and_bucket():
+    codes = list(template.seed_policies().values())
+    progs = [vm.compile_policy(c, N, G) for c in codes]
+    stacked = vm.stack_programs(progs)
+    longest = max(int(p.n_ops) for p in progs)
+    assert stacked.opcode.shape[0] == len(progs)
+    cap = stacked.opcode.shape[1]
+    assert cap >= longest and cap & (cap - 1) == 0  # pow2 bucket
+    assert stacked.n_ops.shape == (len(progs),)
+
+
+def test_stacked_scores_match_per_candidate():
+    """vmapped score_static over a stacked generation == per-candidate
+    score, integer-exact."""
+    rng = np.random.default_rng(5)
+    codes = _corpus()[:6]
+    progs = [vm.compile_policy(c, N, G) for c in codes]
+    stacked = vm.stack_programs(progs)
+    pod, nodes = _rand_views(rng)
+    batched = jax.jit(jax.vmap(vm.score_static, in_axes=(0, None, None)))
+    got = np.asarray(batched(stacked, pod, nodes))
+    for i, prog in enumerate(progs):
+        np.testing.assert_array_equal(
+            got[i], np.asarray(vm.score(prog, pod, nodes)))
+
+
+def test_evaluator_batches_a_generation():
+    """evaluate() on a mixed generation: VM-able candidates land in ONE
+    batched launch, the VM-unsupported one falls to the jit tier, a syntax
+    error maps to 0.0 — and every fitness equals evaluate_one's."""
+    wl = _micro_workload()
+    vmable = _corpus()[:5]
+    hard = template.fill_template(
+        "gpus = sorted(g.gpu_milli_left for g in node.gpus)\n"
+        "return max(1, gpus[0]) if pod.num_gpu == 0 else 1")
+    codes = vmable[:3] + [hard, "def broken(:"] + vmable[3:]
+
+    ev = backend.CodeEvaluator(wl, vm_batch=True)
+    recs = ev.evaluate(codes)
+    assert len(recs) == len(codes)
+    assert ev.vm_batch_count == 1  # one device launch for the generation
+    assert ev.vm_count == len(vmable)
+    assert ev.compile_count == 1  # only the VM-unsupported candidate
+    assert recs[4].score == 0.0 and "syntax" in recs[4].error
+
+    solo = backend.CodeEvaluator(wl, vm_batch=False)
+    for rec, code in zip(recs, codes):
+        if code == "def broken(:":
+            continue
+        one = solo.evaluate_one(code)
+        assert rec.score == one.score, code
+        assert rec.ok == one.ok
+
+
+def test_single_candidate_keeps_unbatched_vm_tier():
+    wl = _micro_workload()
+    ev = backend.CodeEvaluator(wl, vm_batch=True)
+    code = list(template.seed_policies().values())[0]
+    rec = ev.evaluate([code])[0]
+    assert rec.ok
+    assert ev.vm_batch_count == 0  # no population program for one lane
+    assert ev.vm_count == 1 and ev.compile_count == 0
+
+
+def test_duplicate_candidates_evaluate_once():
+    wl = _micro_workload()
+    ev = backend.CodeEvaluator(wl, vm_batch=True)
+    codes = list(template.seed_policies().values())
+    recs = ev.evaluate(codes + codes)
+    assert ev.vm_count == len(codes)
+    for a, b in zip(recs[:len(codes)], recs[len(codes):]):
+        assert a.score == b.score
