@@ -150,6 +150,9 @@ class CodeEvaluator:
         padded = list(progs) + [progs[-1]] * (pop - len(progs))
         stacked = vm.stack_programs(padded)
         result = self._vm_pop_runner()(stacked, self.state0)
+        # ONE device->host transfer for the whole generation: slicing lazy
+        # device arrays would cost ~3 tiny syncs per lane in _record
+        result = jax.device_get(result)
         with self._lock:
             self.vm_batch_count += 1
             self.vm_count += len(progs)

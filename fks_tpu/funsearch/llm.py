@@ -100,7 +100,20 @@ class OpenAIBackend:
             try:
                 with urllib.request.urlopen(
                         req, timeout=min(self.timeout, remaining)) as r:
-                    resp = json.loads(r.read().decode())
+                    # chunked read with deadline checks: urlopen's timeout
+                    # is per-socket-operation, so a drip-feeding endpoint
+                    # resets it with every byte — the overall bound comes
+                    # from re-checking t_end between chunks
+                    chunks = []
+                    while True:
+                        if time.monotonic() >= t_end:
+                            raise TimeoutError(
+                                "deadline exhausted mid-response")
+                        chunk = r.read(65536)
+                        if not chunk:
+                            break
+                        chunks.append(chunk)
+                    resp = json.loads(b"".join(chunks).decode())
                 return (resp["choices"][0]["message"]["content"] or "").strip()
             except urllib.error.HTTPError as e:
                 last = e

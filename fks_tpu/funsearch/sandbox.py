@@ -236,14 +236,32 @@ def _deadline(seconds: Optional[float]):
     try:
         yield
     finally:
-        if prev_delay:  # re-arm an outer watchdog (minus our elapsed time)
-            signal.setitimer(
-                signal.ITIMER_REAL,
-                max(0.001, prev_delay - (time.monotonic() - t0)),
-                prev_interval)
-        else:
-            signal.setitimer(signal.ITIMER_REAL, 0.0)
-        signal.signal(signal.SIGALRM, old)
+        # Alarm-safe cleanup: a fire in the instants after the candidate
+        # finishes must neither skip the handler restore nor surface as a
+        # timeout for a call that completed in time. Block the signal for
+        # the whole cleanup, consume any pending fire, then restore the
+        # previous handler/timer (re-arming an outer watchdog minus our
+        # elapsed time).
+        try:
+            signal.pthread_sigmask(signal.SIG_BLOCK, {signal.SIGALRM})
+            masked = True
+        except (AttributeError, OSError, ValueError):
+            masked = False
+        try:
+            if prev_delay:
+                signal.setitimer(
+                    signal.ITIMER_REAL,
+                    max(0.001, prev_delay - (time.monotonic() - t0)),
+                    prev_interval)
+            else:
+                signal.setitimer(signal.ITIMER_REAL, 0.0)
+            if masked:
+                signal.sigtimedwait([signal.SIGALRM], 0)
+        finally:
+            signal.signal(signal.SIGALRM, old)
+            if masked:
+                signal.pthread_sigmask(
+                    signal.SIG_UNBLOCK, {signal.SIGALRM})
 
 
 def compile_policy(code: str, entry_point: str = "priority_function"):

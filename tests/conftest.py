@@ -81,3 +81,24 @@ def golden_alt():
 def default_workload():
     from fks_tpu.data import TraceParser
     return TraceParser().parse_workload()
+
+
+def make_micro_workload():
+    """Tiny 2-node x 6-pod cluster for fast-tier end-to-end tests (one
+    GPU node, one CPU-only node, alternating GPU/CPU pods)."""
+    from fks_tpu.data.build import make_workload
+
+    nodes = [{"node_id": "n0", "cpu_milli": 4000, "memory_mib": 8000,
+              "gpus": [1000, 1000]},
+             {"node_id": "n1", "cpu_milli": 2000, "memory_mib": 4000,
+              "gpus": []}]
+    pods = [{"pod_id": f"p{i}", "cpu_milli": 500, "memory_mib": 500,
+             "num_gpu": i % 2, "gpu_milli": 300 * (i % 2),
+             "creation_time": i, "duration_time": 5} for i in range(6)]
+    return make_workload(nodes, pods, pad_nodes_to=2, pad_gpus_to=2,
+                         pad_pods_to=8)
+
+
+@pytest.fixture(scope="session")
+def micro_workload():
+    return make_micro_workload()

@@ -13,20 +13,6 @@ from fks_tpu.funsearch import backend, template, vm
 from tests.test_vm import _corpus, _rand_views, G, N
 
 
-def _micro_workload():
-    from fks_tpu.data.build import make_workload
-
-    nodes = [{"node_id": "n0", "cpu_milli": 4000, "memory_mib": 8000,
-              "gpus": [1000, 1000]},
-             {"node_id": "n1", "cpu_milli": 2000, "memory_mib": 4000,
-              "gpus": []}]
-    pods = [{"pod_id": f"p{i}", "cpu_milli": 500, "memory_mib": 500,
-             "num_gpu": i % 2, "gpu_milli": 300 * (i % 2),
-             "creation_time": i, "duration_time": 5} for i in range(6)]
-    return make_workload(nodes, pods, pad_nodes_to=2, pad_gpus_to=2,
-                         pad_pods_to=8)
-
-
 def test_pad_capacity_is_semantically_neutral():
     """NOP padding never changes scores: score_static over the padded
     capacity equals score over the live op count."""
@@ -68,11 +54,11 @@ def test_stacked_scores_match_per_candidate():
             got[i], np.asarray(vm.score(prog, pod, nodes)))
 
 
-def test_evaluator_batches_a_generation():
+def test_evaluator_batches_a_generation(micro_workload):
     """evaluate() on a mixed generation: VM-able candidates land in ONE
     batched launch, the VM-unsupported one falls to the jit tier, a syntax
     error maps to 0.0 — and every fitness equals evaluate_one's."""
-    wl = _micro_workload()
+    wl = micro_workload
     vmable = _corpus()[:5]
     hard = template.fill_template(
         "gpus = sorted(g.gpu_milli_left for g in node.gpus)\n"
@@ -96,8 +82,8 @@ def test_evaluator_batches_a_generation():
         assert rec.ok == one.ok
 
 
-def test_single_candidate_keeps_unbatched_vm_tier():
-    wl = _micro_workload()
+def test_single_candidate_keeps_unbatched_vm_tier(micro_workload):
+    wl = micro_workload
     ev = backend.CodeEvaluator(wl, vm_batch=True)
     code = list(template.seed_policies().values())[0]
     rec = ev.evaluate([code])[0]
@@ -106,8 +92,8 @@ def test_single_candidate_keeps_unbatched_vm_tier():
     assert ev.vm_count == 1 and ev.compile_count == 0
 
 
-def test_duplicate_candidates_evaluate_once():
-    wl = _micro_workload()
+def test_duplicate_candidates_evaluate_once(micro_workload):
+    wl = micro_workload
     ev = backend.CodeEvaluator(wl, vm_batch=True)
     codes = list(template.seed_policies().values())
     recs = ev.evaluate(codes + codes)
