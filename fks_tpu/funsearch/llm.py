@@ -102,14 +102,17 @@ class OpenAIBackend:
                         req, timeout=min(self.timeout, remaining)) as r:
                     # chunked read with deadline checks: urlopen's timeout
                     # is per-socket-operation, so a drip-feeding endpoint
-                    # resets it with every byte — the overall bound comes
-                    # from re-checking t_end between chunks
+                    # resets it with every byte. read1 issues at most ONE
+                    # underlying recv (read(n) would loop recvs until n
+                    # bytes arrive, deferring the check indefinitely), so
+                    # t_end is re-checked per recv and the overall bound
+                    # is ~deadline + one socket timeout.
                     chunks = []
                     while True:
                         if time.monotonic() >= t_end:
                             raise TimeoutError(
                                 "deadline exhausted mid-response")
-                        chunk = r.read(65536)
+                        chunk = r.read1(65536)
                         if not chunk:
                             break
                         chunks.append(chunk)

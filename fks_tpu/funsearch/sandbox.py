@@ -255,8 +255,8 @@ def _deadline(seconds: Optional[float]):
                     prev_interval)
             else:
                 signal.setitimer(signal.ITIMER_REAL, 0.0)
-            if masked:
-                signal.sigtimedwait([signal.SIGALRM], 0)
+            if masked and hasattr(signal, "sigtimedwait"):
+                signal.sigtimedwait([signal.SIGALRM], 0)  # absent on macOS
         finally:
             signal.signal(signal.SIGALRM, old)
             if masked:

@@ -1,0 +1,37 @@
+#!/bin/bash
+# The round-4 TPU evidence session, in priority order (round-3 verdict
+# "Next round" items #1-#6). Run the moment the axon tunnel is healthy
+# (probe: timeout 90 python -c "import jax; print(jax.devices()[0].platform)").
+# Every piece appends to benchmarks/results/round4_tpu.jsonl and survives a
+# wedge mid-way — each stage is its own process-group-killed subprocess, so
+# re-running skips nothing but re-measures cheaply.
+#
+#   1. tpu_session.py core: probe, flat-256 headline, first-ever Mosaic
+#      compile + parity gate + throughput of the fused kernel (asks #1,#2)
+#   2. vmbatch: a generation of LLM code candidates as ONE device launch —
+#      on-chip code-candidate evals/s vs the reference's ~40/s/host (#3)
+#   3. tiers: VM/jit/parametric per-tier device costs (#1)
+#   4. evolve: the full loop on-chip, 20 FakeLLM generations + a
+#      checkpoint resume (#4)
+#   5. scale rows: 1000x20k and the config-5 1000x100k single-chip run (#5)
+#   6. hybrid: time-boxed LLM(Fake)+parametric cross-pollination — champion
+#      work only through the hybrid loop, per #6
+#   7. bench.py, so the self-run JSON matches what the driver records in
+#      BENCH_r04
+set -u
+cd "$(dirname "$0")/.."
+OUT=benchmarks/results/round4_tpu.jsonl
+LOG=benchmarks/results/round4_session.log
+
+python -u tools/tpu_session.py probe flat fused64 gate fused256 vmbatch \
+  tiers evolve scale scale100k 2>&1 | tee -a "$LOG"
+
+# hybrid cross-pollination, time-boxed (verdict #6): does a code candidate
+# ever beat the rendered parametric champion? Admission stats land in $OUT.
+timeout 1500 python -u -m fks_tpu.cli evolve --fake-llm --engine flat \
+  --generations 10 --parametric-rounds 2 \
+  --checkpoint benchmarks/results/r4_hybrid_ck.json \
+  --out policies/discovered --metrics "$OUT" 2>&1 | tee -a "$LOG"
+
+FKS_BENCH_DEADLINE_S=1000 timeout 1100 python bench.py \
+  2>benchmarks/results/round4_bench.stderr | tee -a "$OUT"
