@@ -256,8 +256,17 @@ def _deadline(seconds: Optional[float]):
             else:
                 signal.setitimer(signal.ITIMER_REAL, 0.0)
             if masked and hasattr(signal, "sigtimedwait"):
-                signal.sigtimedwait([signal.SIGALRM], 0)  # absent on macOS
+                signal.sigtimedwait([signal.SIGALRM], 0)
         finally:
+            if masked and not hasattr(signal, "sigtimedwait"):
+                # no sigtimedwait (macOS): drain a pending fire into
+                # SIG_IGN before the old disposition returns — otherwise
+                # unblocking delivers it to SIG_DFL and kills the process
+                signal.signal(signal.SIGALRM, signal.SIG_IGN)
+                signal.pthread_sigmask(
+                    signal.SIG_UNBLOCK, {signal.SIGALRM})
+                signal.pthread_sigmask(
+                    signal.SIG_BLOCK, {signal.SIGALRM})
             signal.signal(signal.SIGALRM, old)
             if masked:
                 signal.pthread_sigmask(

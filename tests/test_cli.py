@@ -141,3 +141,19 @@ def test_metrics_bad_path_fails_fast(micro_cli, tmp_path):
     with pytest.raises(OSError):
         cli.main(["simulate", "--policy", "best_fit",
                   "--metrics", str(tmp_path)])
+
+
+def test_divergence_bound_reads_latest_row(tmp_path):
+    p = tmp_path / "audit.jsonl"
+    rows = [{"trace": "t.csv", "max_abs_d": 0.01},
+            {"trace": "casc.csv", "max_abs_d": 0.43, "max_drift": 0.008,
+             "flat_cascades": 1},
+            {"trace": "t.csv", "max_abs_d": 0.02},  # latest t.csv row wins
+            {"trace": "t.csv", "error": "boom"}]  # error rows are skipped
+    p.write_text("\n".join(json.dumps(r) for r in rows) + "\n")
+    # pre-cascade-era rows (no max_drift) fall back to max_abs_d
+    assert cli._divergence_bound("t.csv", str(p)) == (0.02, 0)
+    # cascade rows report arithmetic drift + the cascade count separately
+    assert cli._divergence_bound("casc.csv", str(p)) == (0.008, 1)
+    assert cli._divergence_bound("missing.csv", str(p)) is None
+    assert cli._divergence_bound("t.csv", str(tmp_path / "nope")) is None
