@@ -1,0 +1,44 @@
+"""Run the FULL test suite (fast + slow tiers) and append one evidence row
+to benchmarks/results/full_suite.jsonl — the per-round CI stand-in the
+README's "CI story for the slow tier" section points at. One row per run:
+pass/fail/deselected counts, wall time, git revision.
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OUT = os.path.join(REPO, "benchmarks", "results", "full_suite.jsonl")
+
+
+def main() -> int:
+    rev = subprocess.run(["git", "rev-parse", "--short", "HEAD"],
+                         capture_output=True, text=True, cwd=REPO
+                         ).stdout.strip()
+    t0 = time.time()
+    proc = subprocess.run(
+        [sys.executable, "-m", "pytest", "tests/", "-q",
+         "-m", "slow or not slow"],
+        capture_output=True, text=True, cwd=REPO)
+    wall = round(time.time() - t0, 1)
+    tail = (proc.stdout or "").strip().splitlines()[-1:]
+    summary = tail[0] if tail else ""
+    counts = {k: int(v) for v, k in re.findall(
+        r"(\d+) (passed|failed|error|skipped|deselected|xfailed)", summary)}
+    row = {"ts": round(time.time(), 1), "rev": rev, "rc": proc.returncode,
+           "wall_s": wall, **counts, "summary": summary}
+    os.makedirs(os.path.dirname(OUT), exist_ok=True)
+    with open(OUT, "a") as f:
+        f.write(json.dumps(row) + "\n")
+    print(json.dumps(row))
+    sys.stderr.write((proc.stdout or "")[-2000:])
+    return proc.returncode
+
+
+if __name__ == "__main__":
+    sys.exit(main())
