@@ -21,7 +21,10 @@ Pipeline:
        so ONE compiled engine serves every candidate of that bucket.
 
 Execution (`score`): ``fori_loop`` over live ops, each a ``lax.switch``
-over ~25 opcodes on [N, G] values. Numeric model: everything runs at the
+over a deliberately minimal 33-opcode table on [N, G] values (scalar
+literals load from a pooled register block, not op slots; boolean and
+sign ops are canonicalized into arithmetic at lowering — see the
+CONST_POOL / opcode-table comments below for the vmap rationale). Numeric model: everything runs at the
 AMBIENT float precision — f64 when x64 is on (CPU tests / golden parity,
 where the transpiler also computes floats in f64, matching the reference's
 CPython binary64), f32 otherwise (TPU, where the jit tier is f32 too).
@@ -64,13 +67,31 @@ _NODE_SCALARS = ("cpu_milli_left", "cpu_milli_total", "memory_mib_left",
 _NODE_GRIDS = ("gpu_milli_left", "gpu_milli_total", "gpu_mem_total")
 N_INPUTS = len(_POD_FIELDS) + len(_NODE_SCALARS) + len(_NODE_GRIDS) + 2
 
-# opcodes (order is the lax.switch branch table in `_branches`)
-(OP_NOP, OP_CONST, OP_ADD, OP_SUB, OP_MUL, OP_DIV, OP_MAX, OP_MIN,
- OP_AND, OP_OR, OP_NOT, OP_GE, OP_GT, OP_LT, OP_LE, OP_EQ, OP_NE,
- OP_SEL, OP_TRUNC, OP_FLOOR, OP_CEIL, OP_ABS, OP_NEG, OP_SIGN,
- OP_ISFIN, OP_REM, OP_POW, OP_IPOW, OP_EXP, OP_LOG, OP_SQRT,
+# Constant pool: scalar literals live in a fixed block of registers right
+# after the inputs, filled host-side from ``VMProgram.consts`` — NOT in op
+# slots. Two wins, both sized for the vmapped population path where every
+# branch in the switch table runs for every slot: constants stop consuming
+# slot iterations, and the CONST branch leaves the table entirely. The
+# pool size is FIXED so register numbering is identical across programs
+# (stacked programs must agree on the layout); overflow -> VMUnsupported
+# -> the jit tier.
+CONST_POOL = 32
+
+# opcodes (order is the lax.switch branch table in `_branches`). The table
+# is deliberately MINIMAL: under vmap (population-batched evaluation) the
+# switch index is per-lane data, so XLA executes EVERY branch per op slot
+# and selects — each table entry costs [N, G] work per slot whether or not
+# any program uses it. Ops with an exactness-safe expansion are therefore
+# canonicalized at lowering instead of tabled: AND->MUL, OR->MAX (0/1
+# domain), NOT->1-x, NEG->x*(-1) (sign-exact for -0.0, unlike 0-x),
+# SQUARE->x*x, integer_pow->POW against a pooled constant, and constants
+# load from the pool.
+(OP_NOP, OP_ADD, OP_SUB, OP_MUL, OP_DIV, OP_MAX, OP_MIN,
+ OP_GE, OP_GT, OP_LT, OP_LE, OP_EQ, OP_NE,
+ OP_SEL, OP_TRUNC, OP_FLOOR, OP_CEIL, OP_ABS, OP_SIGN,
+ OP_ISFIN, OP_REM, OP_POW, OP_EXP, OP_LOG, OP_SQRT,
  OP_SIN, OP_COS, OP_TAN, OP_COL, OP_RSUM_G, OP_RMAX_G, OP_RMIN_G,
- OP_SQUARE, OP_SETCOL) = range(40)
+ OP_SETCOL) = range(33)
 
 
 class VMUnsupported(Exception):
@@ -85,7 +106,8 @@ class VMProgram(NamedTuple):
     a: jax.Array  # i32[O] operand register
     b: jax.Array  # i32[O]
     c: jax.Array  # i32[O]
-    imm: jax.Array  # f32[O] immediate (constants, columns, exponents)
+    imm: jax.Array  # f32[O] immediate (COL/SETCOL column index)
+    consts: jax.Array  # f32[CONST_POOL] pooled scalar literals
     n_ops: jax.Array  # i32[] live op count (fori bound; padding never runs)
     out_reg: jax.Array  # i32[]
 
@@ -101,6 +123,7 @@ class _Lowerer:
     def __init__(self, n: int, g: int):
         self.n, self.g = n, g
         self.ops: List[Tuple[int, int, int, int, float]] = []
+        self.consts: List[float] = []  # pool values, register N_INPUTS + i
         self.reg_of: Dict[Any, int] = {}  # jaxpr Var id -> register
         self.const_reg: Dict[float, int] = {}
         self.cse: Dict[Tuple, int] = {}  # value numbering (all ops pure)
@@ -118,17 +141,27 @@ class _Lowerer:
             if r is not None:
                 return r
         self.ops.append((op, a, b, c, float(imm)))
-        r = N_INPUTS + len(self.ops) - 1
+        r = N_INPUTS + CONST_POOL + len(self.ops) - 1
         if op != OP_NOP:
             self.cse[key] = r
         return r
 
     def const(self, v: float) -> int:
+        import math
+
         v = float(v)
-        r = self.const_reg.get(v)
+        # key includes the sign bit: -0.0 == 0.0 in Python, but the pool
+        # value is THE source of the literal and 1/-0 != 1/+0 — collapsing
+        # them would break sign-exactness vs the jit tier
+        key = (v, math.copysign(1.0, v))
+        r = self.const_reg.get(key)
         if r is None:
-            r = self.emit(OP_CONST, imm=v)
-            self.const_reg[v] = r
+            if len(self.consts) >= CONST_POOL:
+                raise VMUnsupported(
+                    f"more than {CONST_POOL} distinct constants")
+            self.consts.append(v)
+            r = N_INPUTS + len(self.consts) - 1
+            self.const_reg[key] = r
         return r
 
     # -- operand resolution
@@ -324,14 +357,20 @@ class _Lowerer:
 
     def _p_integer_pow(self, eqn):
         y = eqn.params["y"]
+        r = self.reg(eqn.invars[0])
         if y == 2:
-            self._unary(eqn, OP_SQUARE)
+            self.bind(eqn.outvars[0], self.emit(OP_MUL, r, r))  # x*x exact
         else:
+            # jnp.power(x, float(y)) — what the removed IPOW branch ran
             self.bind(eqn.outvars[0],
-                      self.emit(OP_IPOW, self.reg(eqn.invars[0]), imm=y))
+                      self.emit(OP_POW, r, self.const(float(y))))
 
     def _p_neg(self, eqn):
-        self._unary(eqn, OP_NEG)
+        # x * -1, NOT 0 - x: sub flips the sign of +0.0 (0 - 0 = +0 where
+        # -(+0) = -0), and 1/-0 != 1/+0 — the mul form is sign-exact
+        self.bind(eqn.outvars[0],
+                  self.emit(OP_MUL, self.reg(eqn.invars[0]),
+                            self.const(-1.0)))
 
     def _p_abs(self, eqn):
         self._unary(eqn, OP_ABS)
@@ -369,19 +408,22 @@ class _Lowerer:
     def _p_is_finite(self, eqn):
         self._unary(eqn, OP_ISFIN)
 
-    # -- logic / comparison (bools are 0/1 f32)
+    # -- logic / comparison (bools are 0/1 f32, so the boolean ops are
+    # plain arithmetic — no dedicated table branches)
 
     def _p_and(self, eqn):
-        self._binary(eqn, OP_AND)
+        self._binary(eqn, OP_MUL)
 
     def _p_or(self, eqn):
-        self._binary(eqn, OP_OR)
+        self._binary(eqn, OP_MAX)
 
     def _p_xor(self, eqn):
         self._binary(eqn, OP_NE)  # 0/1 xor == ne
 
     def _p_not(self, eqn):
-        self._unary(eqn, OP_NOT)
+        self.bind(eqn.outvars[0],
+                  self.emit(OP_SUB, self.const(1.0),
+                            self.reg(eqn.invars[0])))
 
     def _p_ge(self, eqn):
         self._binary(eqn, OP_GE)
@@ -441,10 +483,10 @@ class _Lowerer:
         self._reduce(eqn, OP_RMIN_G, OP_MIN)
 
     def _p_reduce_and(self, eqn):
-        self._reduce(eqn, OP_RMIN_G, OP_AND)
+        self._reduce(eqn, OP_RMIN_G, OP_MUL)  # 0/1 and == mul
 
     def _p_reduce_or(self, eqn):
-        self._reduce(eqn, OP_RMAX_G, OP_OR)
+        self._reduce(eqn, OP_RMAX_G, OP_MAX)
 
 
 def _dummy_views(n: int, g: int) -> Tuple[PodView, NodeView]:
@@ -481,12 +523,15 @@ def compile_policy(code: str, n: int, g: int,
     arr = np.zeros((5, cap), np.float64)
     for k, (op, a, b, c, imm) in enumerate(lo.ops):
         arr[:, k] = (op, a, b, c, imm)
+    pool = np.zeros(CONST_POOL, np.float64)
+    pool[: len(lo.consts)] = lo.consts
     return VMProgram(
         opcode=jnp.asarray(arr[0], jnp.int32),
         a=jnp.asarray(arr[1], jnp.int32),
         b=jnp.asarray(arr[2], jnp.int32),
         c=jnp.asarray(arr[3], jnp.int32),
         imm=jnp.asarray(arr[4], _ambient_float()),
+        consts=jnp.asarray(pool, _ambient_float()),
         n_ops=jnp.asarray(n_ops, jnp.int32),
         out_reg=jnp.asarray(out_reg, jnp.int32),
     )
@@ -526,20 +571,14 @@ def _branches(n: int, g: int):
         return jnp.broadcast_to(
             lax.dynamic_slice_in_dim(va, c, 1, axis=1), (n, g))
 
-    one = jnp.asarray(1.0, F)
-    zero = jnp.asarray(0.0, F)
     return [
         lambda va, vb, vc, im: va,  # NOP (value = operand a)
-        lambda va, vb, vc, im: jnp.full((n, g), im),  # CONST
         lambda va, vb, vc, im: va + vb,
         lambda va, vb, vc, im: va - vb,
         lambda va, vb, vc, im: va * vb,
         lambda va, vb, vc, im: va / vb,
         lambda va, vb, vc, im: jnp.maximum(va, vb),
         lambda va, vb, vc, im: jnp.minimum(va, vb),
-        lambda va, vb, vc, im: va * vb,  # AND on 0/1
-        lambda va, vb, vc, im: jnp.maximum(va, vb),  # OR on 0/1
-        lambda va, vb, vc, im: one - va,  # NOT
         lambda va, vb, vc, im: (va >= vb).astype(F),
         lambda va, vb, vc, im: (va > vb).astype(F),
         lambda va, vb, vc, im: (va < vb).astype(F),
@@ -551,12 +590,10 @@ def _branches(n: int, g: int):
         lambda va, vb, vc, im: jnp.floor(va),
         lambda va, vb, vc, im: jnp.ceil(va),
         lambda va, vb, vc, im: jnp.abs(va),
-        lambda va, vb, vc, im: -va,
         lambda va, vb, vc, im: jnp.sign(va),
         lambda va, vb, vc, im: jnp.isfinite(va).astype(F),
         lambda va, vb, vc, im: jnp.fmod(va, vb),  # REM (trunc-signed)
         lambda va, vb, vc, im: jnp.power(va, vb),
-        lambda va, vb, vc, im: jnp.power(va, im),  # IPOW
         lambda va, vb, vc, im: jnp.exp(va),
         lambda va, vb, vc, im: jnp.log(va),
         lambda va, vb, vc, im: jnp.sqrt(va),
@@ -567,7 +604,6 @@ def _branches(n: int, g: int):
         red(jnp.sum),  # RSUM_G
         red(jnp.max),  # RMAX_G
         red(jnp.min),  # RMIN_G
-        lambda va, vb, vc, im: va * va,  # SQUARE
         lambda va, vb, vc, im: jnp.where(  # SETCOL: va with column im := vb
             jnp.arange(g)[None, :] == im.astype(jnp.int32), vb, va),
     ]
@@ -579,13 +615,18 @@ def _execute(prog: VMProgram, pod: PodView, nodes: NodeView,
     branches = _branches(n, g)
     inp = _inputs(pod, nodes)
     cap = prog.capacity
-    regs = jnp.concatenate([inp, jnp.zeros((cap, n, g), _ambient_float())])
+    pool = jnp.broadcast_to(
+        prog.consts.astype(_ambient_float())[:, None, None],
+        (prog.consts.shape[0], n, g))
+    regs = jnp.concatenate(
+        [inp, pool, jnp.zeros((cap, n, g), _ambient_float())])
+    op_base = N_INPUTS + prog.consts.shape[0]
 
     def body(k, regs):
         res = lax.switch(
             prog.opcode[k], branches,
             regs[prog.a[k]], regs[prog.b[k]], regs[prog.c[k]], prog.imm[k])
-        return lax.dynamic_update_index_in_dim(regs, res, N_INPUTS + k, 0)
+        return lax.dynamic_update_index_in_dim(regs, res, op_base + k, 0)
 
     regs = lax.fori_loop(0, bound, body, regs)
     out = regs[prog.out_reg][:, 0]
@@ -609,8 +650,8 @@ def score_static(prog: VMProgram, pod: PodView, nodes: NodeView) -> jax.Array:
 
     Under ``vmap`` the per-candidate ``n_ops`` is a batched loop bound, so
     ``fori_loop`` would lower to a while_loop whose every iteration selects
-    the full [cap+N_INPUTS, N, G] register file per lane to freeze finished
-    lanes — far more HBM traffic than the ops themselves. Padding slots are
+    the full [N_INPUTS+CONST_POOL+cap, N, G] register file per lane to
+    freeze finished lanes — far more HBM traffic than the ops themselves. Padding slots are
     OP_NOPs (they copy register 0 into a fresh register the output never
     reads), so running every lane to the static capacity is semantically
     free and keeps the loop bound unbatched. Stack candidates with

@@ -100,3 +100,26 @@ def test_duplicate_candidates_evaluate_once(micro_workload):
     assert ev.vm_count == len(codes)
     for a, b in zip(recs[:len(codes)], recs[len(codes):]):
         assert a.score == b.score
+
+
+def test_const_pool_overflow_falls_back():
+    """>CONST_POOL distinct literals -> VMUnsupported (the jit tier's
+    job), never silent pool corruption."""
+    body = "score = 1.0\n"
+    terms = "\n".join(
+        f"    score = score + {i}.{i:03d}1 * pod.cpu_milli"
+        for i in range(vm.CONST_POOL + 2))
+    code = template.fill_template(body + "    " + terms.strip())
+    with pytest.raises(vm.VMUnsupported, match="constants"):
+        vm.compile_policy(code, N, G, capacity=512)
+
+
+def test_const_pool_preserves_signed_zero():
+    """-0.0 and 0.0 are distinct pool entries: 1/min(x, -0.0) style math
+    must match the jit tier's sign semantics."""
+    lo = vm._Lowerer(N, G)
+    r_pos = lo.const(0.0)
+    r_neg = lo.const(-0.0)
+    assert r_pos != r_neg
+    import math
+    assert math.copysign(1.0, lo.consts[r_neg - vm.N_INPUTS]) == -1.0
