@@ -1,0 +1,27 @@
+#!/bin/bash
+# Probe the axon TPU tunnel on a timer and FIRE the round-4 evidence
+# session (tools/tpu_round4.sh) the moment a probe succeeds. Run detached:
+#   nohup bash tools/tpu_watch.sh > benchmarks/results/round4_watch.log 2>&1 &
+# A lockfile prevents double-firing if a manual session is also started.
+set -u
+cd "$(dirname "$0")/.."
+LOCK=benchmarks/results/.r4_session_running
+PROBE='import jax; print(jax.devices()[0].platform)'
+
+while true; do
+  if [ -f "$LOCK" ]; then
+    echo "$(date -u +%FT%TZ) session already running/fired; watcher exiting"
+    exit 0
+  fi
+  if timeout 90 python -c "$PROBE" 2>/dev/null | grep -q .; then
+    echo "$(date -u +%FT%TZ) PROBE OK — firing tpu_round4.sh"
+    touch "$LOCK"
+    bash tools/tpu_round4.sh
+    rc=$?
+    echo "$(date -u +%FT%TZ) session finished rc=$rc"
+    # leave the lock in place: the session ran; a re-run is a human call
+    exit $rc
+  fi
+  echo "$(date -u +%FT%TZ) probe timed out (tunnel wedged); sleeping 600s"
+  sleep 600
+done
