@@ -203,12 +203,23 @@ for k in (1, 2):   # fresh candidates each rep: same shapes, no recompile
     jax.block_until_ready(res.policy_score)
     times.append(time.perf_counter() - t0)
 best = min(times)
+# the full lowered set as ONE launch: code-candidate throughput at 3x the
+# population (new shape -> one more compile, then a single timed run)
+big = vm.stack_programs(progs, capacity=CAP)
+res_b = run(big, state0)
+jax.block_until_ready(res_b.policy_score)
+t0 = time.perf_counter()
+res_b = run(big, state0)
+jax.block_until_ready(res_b.policy_score)
+big_s = time.perf_counter() - t0
 print(json.dumps({
     "pop": POP, "capacity": CAP,
     "engine_compile_s": round(compile_s, 2),
     "host_lowering_ms_per_cand": round(1e3 * float(np.mean(lower_s)), 1),
     "best_s": round(best, 3),
     "code_evals_per_sec": round(POP / best, 1),
+    "pop_big": len(progs), "big_s": round(big_s, 3),
+    "code_evals_per_sec_big": round(len(progs) / big_s, 1),
     "vs_reference_host_40eps": round(POP / best / 40.0, 2),
     "scores_sample": np.asarray(res.policy_score)[:4].round(4).tolist()}))
 """),
