@@ -19,8 +19,15 @@ while true; do
     bash tools/tpu_round4.sh
     rc=$?
     echo "$(date -u +%FT%TZ) session finished rc=$rc"
-    # leave the lock in place: the session ran; a re-run is a human call
-    exit $rc
+    if grep -q '"ok": true' benchmarks/results/round4_tpu.jsonl 2>/dev/null
+    then
+      # real measurements landed; a re-run is a human call
+      exit $rc
+    fi
+    # the window closed before anything landed (wedged mid-probe):
+    # re-arm and keep watching
+    echo "$(date -u +%FT%TZ) no stage succeeded; re-arming watcher"
+    rm -f "$LOCK"
   fi
   echo "$(date -u +%FT%TZ) probe timed out (tunnel wedged); sleeping 600s"
   sleep 600
