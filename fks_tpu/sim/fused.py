@@ -173,7 +173,11 @@ def _kernel(plan: _Plan, lanes: int,
     gpu[:] = jnp.broadcast_to(nrow_ref[2:3, :], (L, N))
     gmil[:] = jnp.broadcast_to(gmt_ref[:][None, :, :], (L, N, G))
     hist[:] = jnp.zeros((L, H), jnp.int32)
-    acci[:] = jnp.zeros((L, 8), jnp.int32).at[:, 0].set(plan.pending0)
+    # iota/where blend, not ``.at[:, 0].set`` — basic-index .at updates
+    # lower to lax.scatter, which Mosaic has no TPU lowering for (first
+    # real-hardware compile, round-4 session stage fused64)
+    acci[:] = jnp.where(_iota((L, 8), 1) == 0,
+                        jnp.int32(plan.pending0), jnp.int32(0))
     accf[:] = jnp.zeros((L, 8), f32)
 
     w_all = params_ref[:]                     # [L, F]
