@@ -147,7 +147,10 @@ def main():
     # attributed to the generation
     out["evolve_xla_compiles"] = ev.compile_count - compiles_before
 
-    print(json.dumps(out, indent=2))
+    # compact, single line: tpu_session.py's stage runner takes the LAST
+    # parsable stdout line as the stage payload — an indented dump would
+    # leave it only a closing brace
+    print(json.dumps(out))
     if args.metrics:
         from fks_tpu.utils import MetricsWriter
         with MetricsWriter(args.metrics) as mw:

@@ -1,37 +1,55 @@
 #!/bin/bash
 # The round-4 TPU evidence session, in priority order (round-3 verdict
-# "Next round" items #1-#6). Run the moment the axon tunnel is healthy
-# (probe: timeout 90 python -c "import jax; print(jax.devices()[0].platform)").
-# Every piece appends to benchmarks/results/round4_tpu.jsonl and survives a
-# wedge mid-way — each stage is its own process-group-killed subprocess, so
-# re-running skips nothing but re-measures cheaply.
+# "Next round" items #1-#6). Fired by tools/tpu_watch.sh on a healthy
+# probe, or by hand. Every piece appends to
+# benchmarks/results/round4_tpu.jsonl and survives a wedge mid-way:
+# stages that already landed ok are SKIPPED on the next fire
+# (tpu_session.py done_stages), a shared persistent XLA cache makes
+# re-fired stages cheap, and the session aborts early when the tunnel
+# wedges so the watcher can re-arm instead of burning every remaining
+# stage against a dead device (the first round-4 window lost tiers to
+# exactly that cascade).
 #
-#   1. tpu_session.py core: probe, flat-256 headline, first-ever Mosaic
-#      compile + parity gate + throughput of the fused kernel (asks #1,#2)
-#   2. vmbatch: a generation of LLM code candidates as ONE device launch —
-#      on-chip code-candidate evals/s vs the reference's ~40/s/host (#3)
-#   3. tiers: VM/jit/parametric per-tier device costs (#1)
-#   4. evolve: the full loop on-chip, 20 FakeLLM generations + a
-#      checkpoint resume (#4)
-#   5. scale rows: 1000x20k and the config-5 1000x100k single-chip run (#5)
-#   6. hybrid: time-boxed LLM(Fake)+parametric cross-pollination — champion
-#      work only through the hybrid loop, per #6
-#   7. bench.py, so the self-run JSON matches what the driver records in
-#      BENCH_r04
-set -u
+#   1. tpu_session.py (stage order = its ORDER): first-ever Mosaic
+#      compile + parity gate + throughput of the fused kernel (#1,#2),
+#      batched VM code-candidate launches pop 8/32 (#3), flat-256
+#      headline, tiers, on-chip evolve + resume (#4), scale + the
+#      config-5 100k-pod single-chip run (#5)
+#   2. hybrid cross-pollination, time-boxed (#6)
+#   3. bench.py, so the self-run JSON matches what the driver records
+#      in BENCH_r04
+set -u -o pipefail
 cd "$(dirname "$0")/.."
 OUT=benchmarks/results/round4_tpu.jsonl
 LOG=benchmarks/results/round4_session.log
 
-python -u tools/tpu_session.py probe flat fused64 gate fused256 vmbatch \
-  tiers evolve scale scale100k 2>&1 | tee -a "$LOG"
+python -u tools/tpu_session.py "$@" 2>&1 | tee -a "$LOG"
+rc=$?
+if [ "$rc" -ne 0 ]; then
+  echo "session incomplete (rc=$rc); skipping hybrid+bench this window"
+  exit "$rc"
+fi
+if [ "$#" -gt 0 ]; then
+  # a manual selective run measures only what was asked; hybrid+bench
+  # belong to the full session (the watcher's no-args fire)
+  exit 0
+fi
 
 # hybrid cross-pollination, time-boxed (verdict #6): does a code candidate
 # ever beat the rendered parametric champion? Admission stats land in $OUT.
+# A completed earlier hybrid resumes from its checkpoint and exits fast,
+# so re-fires are cheap. Failures propagate: the watcher only stops once
+# session + hybrid + bench ALL landed.
 timeout 1500 python -u -m fks_tpu.cli evolve --fake-llm --engine flat \
   --generations 10 --parametric-rounds 2 \
   --checkpoint benchmarks/results/r4_hybrid_ck.json \
   --out policies/discovered --metrics "$OUT" 2>&1 | tee -a "$LOG"
+hrc=$?
+[ "$hrc" -ne 0 ] && { echo "hybrid failed rc=$hrc"; exit "$hrc"; }
 
 FKS_BENCH_DEADLINE_S=1000 timeout 1100 python bench.py \
   2>benchmarks/results/round4_bench.stderr | tee -a "$OUT"
+brc=$?
+# bench.py prints a value:0.0 fallback line on probe failure but exits 1
+[ "$brc" -ne 0 ] && { echo "bench failed rc=$brc"; exit "$brc"; }
+exit 0

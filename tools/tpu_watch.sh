@@ -1,34 +1,54 @@
 #!/bin/bash
 # Probe the axon TPU tunnel on a timer and FIRE the round-4 evidence
-# session (tools/tpu_round4.sh) the moment a probe succeeds. Run detached:
+# session (tools/tpu_round4.sh) each time a probe succeeds, until the
+# session completes rc=0 (every stage landed ok — already-landed stages
+# are skipped inside tpu_session.py, so each fire only runs what is
+# still missing). Run detached:
 #   nohup bash tools/tpu_watch.sh > benchmarks/results/round4_watch.log 2>&1 &
 # A lockfile prevents double-firing if a manual session is also started.
 set -u
 cd "$(dirname "$0")/.."
 LOCK=benchmarks/results/.r4_session_running
-PROBE='import jax; print(jax.devices()[0].platform)'
+MAX_FIRES=8   # a stage broken for real (not a wedge) must not spin forever
+fires=0
+PROBE='import jax, jax.numpy as jnp
+x = jnp.ones((8, 128)); (x @ x.T).sum().block_until_ready()
+print(jax.devices()[0].platform)'
 
 while true; do
   if [ -f "$LOCK" ]; then
-    echo "$(date -u +%FT%TZ) session already running/fired; watcher exiting"
-    exit 0
+    holder=$(cat "$LOCK" 2>/dev/null)
+    if [ -n "$holder" ] && kill -0 "$holder" 2>/dev/null; then
+      echo "$(date -u +%FT%TZ) session already running (pid $holder); watcher exiting"
+      exit 0
+    fi
+    # holder died without cleanup (SIGKILL / reboot): a dead lock must
+    # not silently disable the retry-until-done loop
+    echo "$(date -u +%FT%TZ) stale lock (pid ${holder:-none} gone); clearing"
+    rm -f "$LOCK"
   fi
-  if timeout 90 python -c "$PROBE" 2>/dev/null | grep -q .; then
-    echo "$(date -u +%FT%TZ) PROBE OK — firing tpu_round4.sh"
-    touch "$LOCK"
+  if timeout 90 python -c "$PROBE" 2>/dev/null | grep -q tpu; then
+    fires=$((fires + 1))
+    if [ "$fires" -gt "$MAX_FIRES" ]; then
+      echo "$(date -u +%FT%TZ) fire cap ($MAX_FIRES) reached; watcher done"
+      exit 1
+    fi
+    echo "$(date -u +%FT%TZ) PROBE OK — firing tpu_round4.sh (fire $fires)"
+    echo "$$" > "$LOCK"
     bash tools/tpu_round4.sh
     rc=$?
     echo "$(date -u +%FT%TZ) session finished rc=$rc"
-    if grep -q '"ok": true' benchmarks/results/round4_tpu.jsonl 2>/dev/null
-    then
-      # real measurements landed; a re-run is a human call
-      exit $rc
-    fi
-    # the window closed before anything landed (wedged mid-probe):
-    # re-arm and keep watching
-    echo "$(date -u +%FT%TZ) no stage succeeded; re-arming watcher"
     rm -f "$LOCK"
+    if [ "$rc" -eq 0 ]; then
+      echo "$(date -u +%FT%TZ) all stages landed; watcher done"
+      exit 0
+    fi
+    # incomplete (wedge mid-session or a failing stage): re-arm; the
+    # next fire skips everything that already landed
+    echo "$(date -u +%FT%TZ) session incomplete; re-arming watcher"
+    sleep 120
+    continue
   fi
-  echo "$(date -u +%FT%TZ) probe timed out (tunnel wedged); sleeping 600s"
-  sleep 600
+  echo "$(date -u +%FT%TZ) probe timed out (tunnel wedged); sleeping 300s"
+  sleep 300
 done
