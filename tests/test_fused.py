@@ -222,3 +222,28 @@ def test_parametric_evolution_on_fused_engine():
     assert second.best_score >= 0.0
     assert pe.best_score >= first.best_score
     assert "priority_function" in pe.best_code()
+
+
+def test_mosaic_lowering_for_tpu_from_cpu():
+    """The kernel LOWERS for the TPU target (host-side Mosaic pass) even
+    on a CPU-only host. Interpret mode accepts primitives real Mosaic
+    rejects — the first on-hardware compile of this kernel failed on a
+    ``.at[:, 0].set`` scatter that every interpret-mode test had passed
+    (round-4 session, stage fused64). This pins the full primitive set:
+    any future edit that sneaks a non-lowerable op in fails HERE, not in
+    a scarce healthy-tunnel window."""
+    wl = _roomy()
+    cfg = SimConfig(max_steps=4 * 48, track_ctime=False)
+    params = parametric.init_population(jax.random.PRNGKey(0), 8, noise=0.1)
+    run = fused.make_fused_population_run(wl, cfg, lanes=8, interpret=False)
+    # lower under the kernel's real conditions: the session runs without
+    # x64 (the kernel pins i32/f32); under the test harness's global x64
+    # the mosaic pass recurses without terminating (jax-internal), which
+    # no production path ever hits
+    prev_x64 = jax.config.jax_enable_x64
+    jax.config.update("jax_enable_x64", False)
+    try:
+        low = jax.jit(run).trace(params).lower(lowering_platforms=("tpu",))
+    finally:
+        jax.config.update("jax_enable_x64", prev_x64)
+    assert "tpu_custom_call" in low.as_text()

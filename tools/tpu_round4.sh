@@ -25,15 +25,19 @@ LOG=benchmarks/results/round4_session.log
 
 python -u tools/tpu_session.py "$@" 2>&1 | tee -a "$LOG"
 rc=$?
-if [ "$rc" -ne 0 ]; then
-  echo "session incomplete (rc=$rc); skipping hybrid+bench this window"
+if [ "$rc" -ne 0 ] && [ "$rc" -ne 1 ]; then
+  # rc=3: device wedged mid-session — nothing more can land this window.
+  # rc=1 with a healthy device means a stage is broken for real; hybrid
+  # and bench are independent evidence, so bank them anyway below.
+  echo "session aborted (rc=$rc); skipping hybrid+bench this window"
   exit "$rc"
 fi
 if [ "$#" -gt 0 ]; then
   # a manual selective run measures only what was asked; hybrid+bench
   # belong to the full session (the watcher's no-args fire)
-  exit 0
+  exit "$rc"
 fi
+session_rc=$rc
 
 # hybrid cross-pollination, time-boxed (verdict #6): does a code candidate
 # ever beat the rendered parametric champion? Admission stats land in $OUT.
@@ -52,4 +56,5 @@ FKS_BENCH_DEADLINE_S=1000 timeout 1100 python bench.py \
 brc=$?
 # bench.py prints a value:0.0 fallback line on probe failure but exits 1
 [ "$brc" -ne 0 ] && { echo "bench failed rc=$brc"; exit "$brc"; }
-exit 0
+# hybrid+bench landed; overall success still requires every session stage
+exit "$session_rc"

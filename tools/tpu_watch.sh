@@ -34,8 +34,12 @@ while true; do
       exit 1
     fi
     echo "$(date -u +%FT%TZ) PROBE OK — firing tpu_round4.sh (fire $fires)"
-    echo "$$" > "$LOCK"
-    bash tools/tpu_round4.sh
+    # the lock holds the SESSION's pid, not the watcher's: if the watcher
+    # is SIGKILLed the session child survives, and a restarted watcher
+    # must see the lock as live until that session actually exits
+    bash tools/tpu_round4.sh &
+    echo "$!" > "$LOCK"
+    wait "$!"
     rc=$?
     echo "$(date -u +%FT%TZ) session finished rc=$rc"
     rm -f "$LOCK"
