@@ -46,6 +46,8 @@ def record(obj):
     print(json.dumps(obj), flush=True)
 
 
+# bench.py defaults to the same path for the driver's standalone run —
+# keep the two in sync if this ever moves
 CACHE_DIR = os.path.join(REPO, "benchmarks", "results", ".jax_cache")
 
 
@@ -365,8 +367,10 @@ def main():
         timeout_s, code = STAGES[name]
         ok = run_stage(name, code, timeout_s)
         if name == "probe" and not ok:
+            # same exit as a mid-session wedge: the device is unreachable,
+            # so the caller must not spend the window on hybrid/bench
             log("device unreachable; aborting session")
-            return 1
+            return 3
         if not ok:
             all_ok = False
             # distinguish "this stage is broken" from "the tunnel died
