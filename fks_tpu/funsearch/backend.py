@@ -99,6 +99,16 @@ class CodeEvaluator:
         if vm_batch is None:
             vm_batch = jax.default_backend() != "cpu"
         self.vm_batch = vm_batch
+        # Bounded device-call length for the batched tier (flat engine
+        # only): the axon TPU tunnel kills single device executions over
+        # ~60 s (bench.py protocol), and a full-trace batched-VM launch
+        # can exceed that regardless of population size. 0 disables.
+        seg = os.environ.get("FKS_VM_SEG_STEPS")
+        if seg is not None:
+            self.vm_seg_steps = int(seg)
+        else:
+            self.vm_seg_steps = (
+                4096 if jax.default_backend() == "tpu" else 0)
 
     # ----- VM tier: one engine program, candidates as data
 
@@ -132,8 +142,17 @@ class CodeEvaluator:
             # population semantics per SimConfig.cond_policy docs: under
             # vmap a cond runs both branches, so keep cond_policy off and
             # let the self-masking step skip nothing — the batch amortizes
-            self._vm_pop_run = jax.jit(self._mod.make_population_run_fn(
-                self.workload, vm.score_static, self.cfg))
+            if (self.vm_seg_steps > 0
+                    and hasattr(self._mod, "make_segmented_population_run")):
+                # manages its own inner jits; results identical to the
+                # unsegmented runner (tests/test_flat_engine.py)
+                self._vm_pop_run = self._mod.make_segmented_population_run(
+                    self.workload, vm.score_static, self.cfg,
+                    seg_steps=self.vm_seg_steps)
+            else:
+                self._vm_pop_run = jax.jit(
+                    self._mod.make_population_run_fn(
+                        self.workload, vm.score_static, self.cfg))
         return self._vm_pop_run
 
     def _run_vm_batch(self, progs: List[vm.VMProgram]) -> List[SimResult]:

@@ -477,3 +477,68 @@ def make_population_run_fn(workload: Workload, param_policy,
         return jax.vmap(lambda s: finalize(workload, cfg, s))(final)
 
     return run
+
+
+def make_segmented_population_run(workload: Workload, param_policy,
+                                  cfg: SimConfig = SimConfig(),
+                                  seg_steps: int = 4096):
+    """``make_population_run_fn`` with a bounded device-call length: the
+    while_loop stops every ``seg_steps`` events and the carry returns to
+    the host, which re-dispatches until every lane drains.
+
+    Exists for runtimes that kill long single device executions (the axon
+    TPU tunnel kills calls over ~60 s — bench.py protocol notes): a
+    full-trace batched-VM launch or a 100k-pod scale run can exceed the
+    window no matter the population size, since wall time scales with
+    steps, not lanes. Overhead per segment is one dispatch plus one
+    scalar device->host sync (the any-lane-active flag travels with the
+    carry, not as a second dispatch). Active lanes advance in lockstep
+    (the self-masking step freezes only finished lanes), so
+    ``steps - start`` is uniform across active lanes and the segment
+    bound is exact.
+
+    Results are identical to the unsegmented runner: the carry is the
+    same, only the while_loop is split (pinned by
+    tests/test_flat_engine.py::test_segmented_population_matches).
+    """
+    if seg_steps <= 0:
+        raise ValueError(
+            f"seg_steps must be positive, got {seg_steps}; to disable "
+            "segmentation use make_population_run_fn")
+    ktable, max_steps = loop_tables(workload, cfg)
+
+    def step_one(prm, s):
+        return build_step(
+            workload, lambda pod, nodes: param_policy(prm, pod, nodes),
+            cfg, ktable, max_steps)(s)
+
+    vstep = jax.vmap(step_one, in_axes=(0, 0))
+
+    @jax.jit
+    def advance(params, bstate):
+        start = bstate.steps  # frozen at segment entry
+
+        def cond(s):
+            return jnp.any(lane_active(s, max_steps)
+                           & (s.steps - start < seg_steps))
+
+        out = jax.lax.while_loop(
+            cond, lambda s: vstep(params, s), bstate)
+        return out, jnp.any(lane_active(out, max_steps))
+
+    @jax.jit
+    def finalize_pop(bstate):
+        return jax.vmap(lambda s: finalize(workload, cfg, s))(bstate)
+
+    def run(params, state0: FlatState) -> SimResult:
+        pop = jax.tree_util.tree_leaves(params)[0].shape[0]
+        bstate = broadcast_state(state0, pop)
+        # segment count is bounded by the step budget, so a cond/step
+        # divergence cannot spin the host loop forever
+        for _ in range(-(-max_steps // seg_steps) + 1):
+            bstate, active = advance(params, bstate)
+            if not bool(active):  # the only per-segment host sync
+                break
+        return finalize_pop(bstate)
+
+    return run

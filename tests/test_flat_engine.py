@@ -226,3 +226,39 @@ def test_unpacked_aux_gpus_with_contention():
     # every assigned pod holds exactly num_gpu distinct GPUs
     bits = np.asarray(res.assigned_gpus)[:6]
     assert all(bin(int(b)).count("1") == 12 for b in bits)
+
+
+def test_segmented_population_matches():
+    """make_segmented_population_run splits the while_loop into bounded
+    device calls (axon-tunnel kill-window protection); every SimResult
+    field must be identical to the unsegmented runner, including with a
+    segment length that forces many host round-trips and one that exceeds
+    the whole run (degenerate single segment)."""
+    from fks_tpu.models import parametric
+
+    wl = _roomy_workload(num_pods=40, seed=3)
+    cfg = SimConfig(track_ctime=False)
+    params = parametric.init_population(jax.random.PRNGKey(2), 4, noise=0.1)
+    s0 = flat.initial_state(wl, cfg)
+    ref = jax.jit(flat.make_population_run_fn(wl, parametric.score, cfg))(
+        params, s0)
+    for seg in (7, 10_000):
+        seg_run = flat.make_segmented_population_run(
+            wl, parametric.score, cfg, seg_steps=seg)
+        _assert_results_equal(seg_run(params, s0), ref)
+
+
+def test_segmented_population_with_contention_and_truncation():
+    """Segmentation must also agree when lanes fail placements (retries
+    queue new events mid-run) and when the step budget truncates lanes."""
+    from fks_tpu.models import parametric
+
+    wl = micro_workload()
+    cfg = SimConfig(max_steps=9)  # truncates some lanes mid-trace
+    params = parametric.init_population(jax.random.PRNGKey(4), 3, noise=0.3)
+    s0 = flat.initial_state(wl, cfg)
+    ref = jax.jit(flat.make_population_run_fn(wl, parametric.score, cfg))(
+        params, s0)
+    seg_run = flat.make_segmented_population_run(
+        wl, parametric.score, cfg, seg_steps=2)
+    _assert_results_equal(seg_run(params, s0), ref)

@@ -123,3 +123,21 @@ def test_const_pool_preserves_signed_zero():
     assert r_pos != r_neg
     import math
     assert math.copysign(1.0, lo.consts[r_neg - vm.N_INPUTS]) == -1.0
+
+
+def test_segmented_batch_tier_matches_unsegmented(micro_workload, monkeypatch):
+    """FKS_VM_SEG_STEPS forces the batched tier through the segmented
+    runner (the TPU default — axon-tunnel kill-window protection); every
+    generation fitness must match the monolithic launch."""
+    monkeypatch.setenv("FKS_VM_SEG_STEPS", "3")
+    seg = backend.CodeEvaluator(micro_workload, vm_batch=True, engine="flat")
+    assert seg.vm_seg_steps == 3
+    monkeypatch.setenv("FKS_VM_SEG_STEPS", "0")
+    mono = backend.CodeEvaluator(micro_workload, vm_batch=True, engine="flat")
+    assert mono.vm_seg_steps == 0
+    codes = _corpus()[:4]
+    a = seg.evaluate(codes)
+    b = mono.evaluate(codes)
+    assert seg.vm_batch_count == 1 and mono.vm_batch_count == 1
+    for ra, rb in zip(a, b):
+        assert ra.score == rb.score and ra.ok == rb.ok

@@ -213,7 +213,10 @@ land({"stage": "vmbatch_lowering", "ok": True, "n_cands": len(progs),
       "host_lowering_ms_per_cand":
           round(1e3 * float(np.mean(lower_s)), 1)})
 
-run = jax.jit(flat.make_population_run_fn(wl, vm.score_static, cfg))
+# segmented: no single device call exceeds ~seg_steps events, so the
+# tunnel's ~60 s execution kill window cannot kill a full-trace launch
+run = flat.make_segmented_population_run(wl, vm.score_static, cfg,
+                                         seg_steps=4096)
 state0 = flat.initial_state(wl, cfg)
 summary = {"capacity": CAP}
 # smallest-first: pop 8 is EXACTLY one reference generation (<=8
@@ -283,18 +286,22 @@ import json, time
 import jax, numpy as np
 from fks_tpu.data.synthetic import synthetic_workload
 from fks_tpu.models import parametric
-from fks_tpu.parallel import make_population_eval
+from fks_tpu.sim import flat
 from fks_tpu.sim.engine import SimConfig
 nodes, pods, pop = {nodes}, {pods}, {pop}
 wl = synthetic_workload(nodes, pods, seed=0)
 cfg = SimConfig(track_ctime=False)
 params = parametric.init_population(jax.random.PRNGKey(0), pop, noise=0.1)
-ev = make_population_eval(wl, cfg=cfg, engine="flat")
+# segmented so no single device call outlives the tunnel's ~60 s
+# execution kill window (a 100k-pod trace is ~200k+ sequential events)
+run = flat.make_segmented_population_run(wl, parametric.score, cfg,
+                                         seg_steps=16384)
+state0 = flat.initial_state(wl, cfg)
 t0 = time.perf_counter()
-res = ev(params); jax.block_until_ready(res.policy_score)
+res = run(params, state0); jax.block_until_ready(res.policy_score)
 compile_s = time.perf_counter() - t0
 t0 = time.perf_counter()
-res = ev(params); jax.block_until_ready(res.policy_score)
+res = run(params, state0); jax.block_until_ready(res.policy_score)
 best = time.perf_counter() - t0
 print(json.dumps({{"nodes": nodes, "pods": pods, "pop": pop,
                   "compile_s": round(compile_s, 1), "best_s": round(best, 2),
