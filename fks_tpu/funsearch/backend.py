@@ -105,7 +105,18 @@ class CodeEvaluator:
         # can exceed that regardless of population size. 0 disables.
         seg = os.environ.get("FKS_VM_SEG_STEPS")
         if seg is not None:
-            self.vm_seg_steps = int(seg)
+            try:
+                seg_val = int(seg)
+            except ValueError:
+                raise ValueError(
+                    f"FKS_VM_SEG_STEPS must be an integer (segment length "
+                    f"in events; 0 disables segmentation), got {seg!r}"
+                ) from None
+            if seg_val < 0:
+                raise ValueError(
+                    f"FKS_VM_SEG_STEPS must be >= 0 (0 disables "
+                    f"segmentation), got {seg_val}")
+            self.vm_seg_steps = seg_val
         else:
             self.vm_seg_steps = (
                 4096 if jax.default_backend() == "tpu" else 0)

@@ -18,6 +18,7 @@ Additions over the reference, called for by SURVEY.md §5:
 """
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import difflib
 import json
@@ -25,6 +26,8 @@ import os
 import random
 import time
 from typing import Callable, List, Optional, Sequence, Tuple
+
+import jax
 
 from fks_tpu.funsearch import llm as llm_mod
 from fks_tpu.funsearch import template
@@ -181,7 +184,28 @@ class FunSearch:
             self.best = self.population[0]
 
     def _sort(self) -> None:
+        """Descending by search fitness, then the head window re-ranked by
+        EXACT fitness. Fast-engine scores drift from the exact engine by up
+        to ~0.05 on the default trace (tools/divergence_audit.py) while
+        published champion gaps are ~0.01, so a ranking taken raw from the
+        fast engine would aim selection pressure inside the noise band.
+        Re-ranking the top ``2*elite_size`` members by exact-engine fitness
+        (memoized; ≤window extra exact runs per generation, usually just
+        the new head entrants) makes elite selection and parent sampling
+        exact-ranked, as the reference's single-engine sort trivially is
+        (reference: funsearch_integration.py:494-496)."""
         self.population.sort(key=lambda m: m[1], reverse=True)
+        if self.evaluator.engine == "exact" or self.cfg.elite_size <= 0:
+            return
+        window = min(len(self.population), 2 * self.cfg.elite_size)
+        if window <= 1:
+            return
+        head = self.population[:window]
+        # exact first, search fitness as the tie-break (it also orders any
+        # transiently failed rescores, which return 0.0 un-memoized)
+        head.sort(key=lambda m: (self._exact_score(m[0], m[1]), m[1]),
+                  reverse=True)
+        self.population[:window] = head
 
     def _is_too_similar(self, code: str, score: float) -> bool:
         """difflib ratio >= threshold against any incumbent with >= score
@@ -215,11 +239,18 @@ class FunSearch:
         if key in self._exact_memo:
             return self._exact_memo[key]
         try:
-            if self._exact_eval is None:
-                self._exact_eval = CodeEvaluator(
-                    self.evaluator.workload, self.evaluator.cfg,
-                    engine="exact")
-            exact = self._exact_eval.evaluate_one(code).score
+            # pin rescoring to the host CPU: on a TPU session the exact
+            # engine's per-event cost is ~10x the CPU's (PROFILE.md), the
+            # rescore would compete with the search for the device, and
+            # the axon tunnel's execution kill window could take it down
+            # mid-run. The exact engine is integer/deterministic, so the
+            # score is backend-independent.
+            with self._exact_device():
+                if self._exact_eval is None:
+                    self._exact_eval = CodeEvaluator(
+                        self.evaluator.workload, self.evaluator.cfg,
+                        engine="exact")
+                exact = self._exact_eval.evaluate_one(code).score
         except Exception as e:  # noqa: BLE001 — the stated rule: a failed
             # rescore maps to 0.0; it must never kill the evolve loop
             # mid-generation (evaluate_one catches candidate failures, but
@@ -232,6 +263,16 @@ class FunSearch:
             return 0.0
         self._exact_memo[key] = exact
         return exact
+
+    @staticmethod
+    def _exact_device():
+        """Context manager pinning exact rescoring to the host CPU backend
+        (no-op when CPU is unavailable or already the default)."""
+        try:
+            dev = jax.devices("cpu")[0]
+        except RuntimeError:
+            return contextlib.nullcontext()
+        return jax.default_device(dev)
 
     def _admit(self, code: str, score: float) -> None:
         self.population.append((code, score))

@@ -702,3 +702,38 @@ def stack_programs(progs: Sequence[VMProgram],
     cap = capacity or max(32, 1 << max(0, (longest - 1)).bit_length())
     padded = [pad_capacity(p, cap) for p in progs]
     return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *padded)
+
+
+def lower_fake_candidates(n: int, g: int, need: int, *, capacity: int = 256,
+                          seed: int = 7, max_tries_factor: int = 12):
+    """Generate + lower ``need`` FakeLLM candidates to VM programs.
+
+    The shared measurement protocol for code-candidate throughput (bench.py
+    ``codetput`` stage and the TPU session's ``vmbatch`` stage use the same
+    candidate source so their numbers stay apples-to-apples): deterministic
+    FakeLLM completions, template-filled, lowered via ``compile_policy``;
+    junk/too-long candidates are skipped. Returns ``(progs, lower_seconds)``
+    — per-candidate host lowering times ride along for the lowering-cost
+    metric. The attempt loop is bounded by ``max_tries_factor * need``, so
+    a degenerate generator cannot spin forever; callers must check
+    ``len(progs)`` against ``need``.
+    """
+    import time as _time
+
+    from fks_tpu.funsearch import llm, template
+
+    fake = llm.FakeLLM(seed=seed, junk_rate=0.0)
+    progs: List[VMProgram] = []
+    lower_s: List[float] = []
+    for _ in range(max_tries_factor * need):
+        if len(progs) >= need:
+            break
+        code = template.fill_template(fake.complete("x"))
+        t0 = _time.perf_counter()
+        try:
+            prog = compile_policy(code, n, g, capacity=capacity)
+        except Exception:  # noqa: BLE001 — outside the VM vocabulary
+            continue
+        lower_s.append(_time.perf_counter() - t0)
+        progs.append(prog)
+    return progs, lower_s

@@ -1,0 +1,136 @@
+"""Real multi-process distributed-backend test (2 processes x 4 devices).
+
+Round-4 verdict ask #9: the hybrid ("dcn", "pop") mesh and
+``init_distributed`` had only been exercised inside ONE process (the
+8-virtual-device conftest mesh). Here two REAL processes form a
+``jax.distributed`` local cluster over a loopback coordinator, each
+contributing 4 virtual CPU devices, and evaluate a sharded population on
+the global 2x4 hybrid mesh — the same code path a multi-host TPU pod
+takes (SURVEY.md §5: the reference's only inter-worker substrate is a
+single-host ProcessPoolExecutor, funsearch_integration.py:535-562; this
+is its cross-process equivalence test).
+
+Checks: process group forms (process_count == 2, 8 global devices), the
+sharded eval runs across the process boundary, the replicated elite
+outputs AGREE between the two processes, and they match per-candidate
+single-process simulation scores exactly.
+"""
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+CHILD = """
+import json, sys
+import numpy as np
+
+pid, port = int(sys.argv[1]), sys.argv[2]
+
+import jax
+from fks_tpu.parallel.mesh import (
+    hybrid_population_mesh, init_distributed, make_sharded_eval,
+    pad_population)
+
+n = init_distributed(f"localhost:{port}", num_processes=2, process_id=pid)
+assert n == 2, f"process_count {n}"
+assert jax.process_index() == pid
+assert len(jax.devices()) == 8, len(jax.devices())       # global
+assert len(jax.local_devices()) == 4, len(jax.local_devices())
+
+from fks_tpu.data.build import make_workload
+from fks_tpu.models import parametric
+from fks_tpu.sim.engine import SimConfig, simulate
+
+nodes = [
+    {"node_id": "node1", "cpu_milli": 8000, "memory_mib": 16000,
+     "gpus": [1000, 1000], "gpu_memory_mib": 8000},
+    {"node_id": "node2", "cpu_milli": 4000, "memory_mib": 8000, "gpus": []},
+]
+pods = [
+    {"pod_id": "pod1", "cpu_milli": 1000, "memory_mib": 2000, "num_gpu": 0,
+     "gpu_milli": 0, "creation_time": 0, "duration_time": 10},
+    {"pod_id": "pod2", "cpu_milli": 2000, "memory_mib": 4000, "num_gpu": 1,
+     "gpu_milli": 500, "creation_time": 5, "duration_time": 15},
+    {"pod_id": "pod3", "cpu_milli": 3000, "memory_mib": 6000, "num_gpu": 0,
+     "gpu_milli": 0, "creation_time": 10, "duration_time": 8},
+    {"pod_id": "pod4", "cpu_milli": 1500, "memory_mib": 3000, "num_gpu": 2,
+     "gpu_milli": 400, "creation_time": 15, "duration_time": 12},
+]
+wl = make_workload(nodes, pods, pad_nodes_to=4, pad_gpus_to=4, pad_pods_to=8)
+
+mesh = hybrid_population_mesh(num_slices=2)
+assert mesh.axis_names == ("dcn", "pop")
+assert mesh.shape["dcn"] == 2 and mesh.shape["pop"] == 4
+# the outer (DCN) axis really crosses the process boundary
+procs_per_row = [{d.process_index for d in row} for row in mesh.devices]
+assert procs_per_row[0] != procs_per_row[1], procs_per_row
+
+params = np.asarray(parametric.init_population(
+    jax.random.PRNGKey(0), 8, noise=0.2))
+params, real = pad_population(jax.numpy.asarray(params), mesh)
+ev = make_sharded_eval(wl, mesh, elite_k=4, engine="exact")
+scores, elite_idx, elite_scores = ev(params, real)
+es = np.asarray(jax.device_get(elite_scores))    # replicated -> addressable
+ei = np.asarray(jax.device_get(elite_idx))
+
+# single-process reference: each candidate through the plain engine
+ref = np.asarray([float(simulate(wl, parametric.as_policy(
+    jax.numpy.asarray(params)[i])).policy_score) for i in range(8)])
+want = np.sort(ref)[::-1][:4]
+np.testing.assert_allclose(es, want, rtol=0, atol=0)
+np.testing.assert_allclose(ref[ei], es, rtol=0, atol=0)
+
+print("RESULT " + json.dumps({
+    "process": pid, "elite_scores": es.tolist(), "elite_idx": ei.tolist()}))
+"""
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+@pytest.mark.slow
+def test_two_process_hybrid_mesh(tmp_path):
+    port = _free_port()
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    # the axon sitecustomize would try the TPU tunnel at interpreter start
+    env["PYTHONPATH"] = os.pathsep.join(
+        [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+         if p and "axon_site" not in p] + [REPO])
+    script = tmp_path / "child.py"
+    script.write_text(CHILD)
+    procs = [
+        subprocess.Popen([sys.executable, str(script), str(i), str(port)],
+                         env=env, cwd=REPO, text=True,
+                         stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+        for i in range(2)
+    ]
+    outs = []
+    for i, p in enumerate(procs):
+        try:
+            out, err = p.communicate(timeout=300)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail(f"process {i} timed out forming/running the cluster")
+        assert p.returncode == 0, f"process {i} failed:\n{err[-4000:]}"
+        outs.append(out)
+
+    results = []
+    for i, out in enumerate(outs):
+        lines = [l for l in out.splitlines() if l.startswith("RESULT ")]
+        assert lines, f"process {i} printed no result:\n{out[-2000:]}"
+        results.append(json.loads(lines[-1][len("RESULT "):]))
+    # both controllers computed the identical replicated elite set
+    assert results[0]["elite_scores"] == results[1]["elite_scores"]
+    assert results[0]["elite_idx"] == results[1]["elite_idx"]
+    assert results[0]["elite_scores"][0] > 0

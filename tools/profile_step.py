@@ -1,17 +1,32 @@
 """Step-cost profile of the engine's event-loop body on the current device.
 
-VERDICT r1 asked where the ~0.7 ms/step goes on TPU. This tool times each
-component of the per-event step in isolation — loop overhead, heap pop,
-heap push, the O(capacity) first-deletion scan, policy scoring + placement
-arithmetic — as jitted ``lax.while_loop``s over the REAL default-trace
-shapes, at several population widths, and prints a per-step cost table.
+VERDICT r1 asked where the ~0.7 ms/step goes on TPU; VERDICT r4 (ask #5)
+asks which component of the FLAT step explains the measured-vs-projected
+population-throughput gap at pop 256. This tool times each component of
+the per-event step in isolation — loop overhead, heap pop, heap push, the
+O(capacity) first-deletion scan, policy scoring + placement arithmetic —
+as jitted ``lax.while_loop``s over the REAL default-trace shapes, at
+several population widths, and prints a per-step cost table.
 
-Usage:  python tools/profile_step.py [--steps 4096] [--lanes 1,16,256]
-Results are summarized in PROFILE.md.
+Flat-step attribution variants (all under the bench configuration,
+``track_ctime=False, max_steps=4*pods`` — what bench.py actually times):
+  flat-step    parametric policy (the bench workload)
+  flat-ff      first-fit policy (cheap constant scorer) — the delta to
+               flat-step is the parametric FEATURE BASIS cost
+  flat-ffalloc parametric policy, first-fit GPU sub-allocator — the delta
+               isolates the best-fit top_k allocator
+  flat-ctime   parametric policy with the per-event [P]-wide pod_ctime
+               blend ON — what bench saves by turning it off
+
+Usage:  python tools/profile_step.py [--steps 4096] [--lanes 1,16,256] [--json]
+``--json`` appends one machine-readable JSON line (consumed by the TPU
+session's profile256 stage). Results are summarized in PROFILE.md.
 """
 from __future__ import annotations
 
 import argparse
+import dataclasses
+import json
 import os
 import sys
 import time
@@ -35,16 +50,18 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--steps", type=int, default=4096)
     ap.add_argument("--lanes", type=str, default="1,16,256")
+    ap.add_argument("--json", action="store_true",
+                    help="append one machine-readable JSON result line")
     args = ap.parse_args()
     steps = args.steps
     lanes_list = [int(x) for x in args.lanes.split(",")]
 
     from fks_tpu.data import TraceParser
-    from fks_tpu.models import parametric
+    from fks_tpu.models import parametric, zoo
     from fks_tpu.ops.heap import (
         first_deletion_in_array_order, heap_pop, heap_push, KIND_DELETE)
     from fks_tpu.sim.engine import (
-        SimConfig, broadcast_state, build_step, initial_state, loop_tables)
+        SimConfig, build_step, initial_state, loop_tables)
 
     dev = jax.devices()[0]
     print(f"device: {dev.platform} ({dev.device_kind}); steps={steps}",
@@ -96,17 +113,32 @@ def main():
 
     from fks_tpu.sim import flat
 
-    fstate0 = flat.initial_state(wl, cfg)
-    fstep = flat.build_step(
-        wl, lambda pod, nodes: parametric.score(params, pod, nodes),
-        cfg, ktable, max_steps)
+    # flat variants under the BENCH configuration (what bench.py times)
+    cfg_bench = SimConfig(max_steps=4 * wl.num_pods, track_ctime=False)
+    ktable_b, max_steps_b = loop_tables(wl, cfg_bench)
+    cfg_ctime = dataclasses.replace(cfg_bench, track_ctime=True)
+    cfg_ffalloc = dataclasses.replace(cfg_bench, gpu_allocator="first_fit")
+    fstate0 = flat.initial_state(wl, cfg_bench)
+    fstate0_ct = flat.initial_state(wl, cfg_ctime)
 
-    def body_flat(s):
-        return fstep(s)
+    def param_policy(pod, nodes):
+        return parametric.score(params, pod, nodes)
 
-    # policy + placement arithmetic only: run the step but against a heap
-    # pinned to size 0 (active=False) would no-op everything; instead time
-    # the full step minus heap variants by subtraction in the report.
+    ff_policy = zoo.ZOO["first_fit"]()
+
+    fstep = flat.build_step(wl, param_policy, cfg_bench, ktable_b, max_steps_b)
+    fstep_ff = flat.build_step(wl, ff_policy, cfg_bench, ktable_b, max_steps_b)
+    fstep_ffalloc = flat.build_step(
+        wl, param_policy, cfg_ffalloc, ktable_b, max_steps_b)
+    fstep_ctime = flat.build_step(
+        wl, param_policy, cfg_ctime, ktable_b, max_steps_b)
+
+    flat_variants = [
+        ("flat-step", fstep, fstate0),
+        ("flat-ff", fstep_ff, fstate0),
+        ("flat-ffalloc", fstep_ffalloc, fstate0),
+        ("flat-ctime", fstep_ctime, fstate0_ct),
+    ]
 
     rows = []
     for lanes in lanes_list:
@@ -116,8 +148,7 @@ def main():
             ("2pop+2push", body_push_pop, heap0),
             ("del-scan", body_scan, heap0),
             ("full-step", body_full, state0),
-            ("flat-step", body_flat, fstate0),
-        ]:
+        ] + [(n, (lambda s, st=st: st(s)), c0) for n, st, c0 in flat_variants]:
             if lanes == 1:
                 fn = jax.jit(lambda c, b=body: loop(b, c))
                 c0 = carry
@@ -140,7 +171,27 @@ def main():
               f"pop+push={d['pop+repush'] - d['noop']:.1f} "
               f"2pop+2push={d['2pop+2push'] - d['noop']:.1f} "
               f"del-scan={d['del-scan'] - d['noop']:.1f} "
-              f"full={d['full-step']:.1f} flat={d['flat-step']:.1f}")
+              f"full={d['full-step']:.1f} flat={d['flat-step']:.1f} "
+              f"basis={d['flat-step'] - d['flat-ff']:+.1f} "
+              f"alloc={d['flat-step'] - d['flat-ffalloc']:+.1f} "
+              f"ctime={d['flat-ctime'] - d['flat-step']:+.1f}")
+
+    if args.json:
+        payload = {
+            "device": f"{dev.platform}:{dev.device_kind}", "steps": steps,
+            "rows": [{"lanes": l, "name": n, "us_per_step": round(u, 2)}
+                     for (l, n, u) in rows],
+        }
+        for lanes in lanes_list:
+            d = {n: u for (l, n, u) in rows if l == lanes}
+            payload[f"lanes{lanes}"] = {
+                "flat_us": round(d["flat-step"], 2),
+                "basis_us": round(d["flat-step"] - d["flat-ff"], 2),
+                "alloc_us": round(d["flat-step"] - d["flat-ffalloc"], 2),
+                "ctime_us": round(d["flat-ctime"] - d["flat-step"], 2),
+                "exact_full_us": round(d["full-step"], 2),
+            }
+        print(json.dumps(payload), flush=True)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,72 @@
+#!/bin/bash
+# The round-5 TPU evidence session, in priority order (round-4 verdict
+# "Next round" items #1-#8). Fired by tools/tpu_watch.sh on a healthy
+# probe, or by hand. Every piece appends to
+# benchmarks/results/round5_tpu.jsonl and survives a wedge mid-way:
+# stages that already landed ok are SKIPPED on the next fire
+# (tpu_session.py done_stages), a shared persistent XLA cache makes
+# re-fired stages cheap, and the session aborts early when the tunnel
+# wedges so the watcher can re-arm instead of burning every remaining
+# stage against a dead device.
+#
+#   1. tpu_session.py (stage order = its ORDER): the repaired fused
+#      kernel's first on-chip run + gate + throughput (#1), batched VM
+#      code candidates pop 8/32/96 (#2), flat-256 headline, SEEDED
+#      flat-256 (#6), per-component step profile at pop 256 (#5), tiers
+#      incl. exact-engine µs/event (#8), on-chip evolve + resume (#4),
+#      scale + the config-5 100k-pod single-chip run
+#   2. hybrid cross-pollination, time-boxed
+#   3. bench.py, so the self-run JSON matches what the driver records
+#      in BENCH_r05 (bench.py also BANKS this session's freshest
+#      measurement as its fallback payload — verdict ask #3)
+set -u -o pipefail
+cd "$(dirname "$0")/.."
+OUT=benchmarks/results/round5_tpu.jsonl
+LOG=benchmarks/results/round5_session.log
+EXTRAS_DONE=benchmarks/results/.r5_extras_done
+# one cache for session stages AND bench (bench.py defaults to the same
+# path for the driver's standalone end-of-round run)
+export JAX_COMPILATION_CACHE_DIR="$PWD/benchmarks/results/.jax_cache"
+export JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS=1
+
+python -u tools/tpu_session.py "$@" 2>&1 | tee -a "$LOG"
+rc=$?
+if [ "$rc" -ne 0 ] && [ "$rc" -ne 1 ]; then
+  # rc=3: device wedged mid-session — nothing more can land this window.
+  # rc=1 with a healthy device means a stage is broken for real; hybrid
+  # and bench are independent evidence, so bank them anyway below.
+  echo "session aborted (rc=$rc); skipping hybrid+bench this window"
+  exit "$rc"
+fi
+if [ "$#" -gt 0 ]; then
+  # a manual selective run measures only what was asked; hybrid+bench
+  # belong to the full session (the watcher's no-args fire)
+  exit "$rc"
+fi
+session_rc=$rc
+if [ -f "$EXTRAS_DONE" ]; then
+  # hybrid+bench already landed this round; a re-fire is only chasing
+  # missing session stages — don't re-measure (or re-append) the extras
+  exit "$session_rc"
+fi
+
+# hybrid cross-pollination, time-boxed: does a code candidate ever beat
+# the rendered parametric champion? Admission stats land in $OUT.
+# A completed earlier hybrid resumes from its checkpoint and exits fast,
+# so re-fires are cheap. Failures propagate: the watcher only stops once
+# session + hybrid + bench ALL landed.
+timeout 1500 python -u -m fks_tpu.cli evolve --fake-llm --engine flat \
+  --generations 10 --parametric-rounds 2 \
+  --checkpoint benchmarks/results/r5_hybrid_ck.json \
+  --out policies/discovered --metrics "$OUT" 2>&1 | tee -a "$LOG"
+hrc=$?
+[ "$hrc" -ne 0 ] && { echo "hybrid failed rc=$hrc"; exit "$hrc"; }
+
+FKS_BENCH_DEADLINE_S=1000 timeout 1100 python bench.py \
+  2>benchmarks/results/round5_bench.stderr | tee -a "$OUT"
+brc=$?
+# bench.py prints a banked-fallback line on probe failure but exits 1
+[ "$brc" -ne 0 ] && { echo "bench failed rc=$brc"; exit "$brc"; }
+# hybrid+bench landed; overall success still requires every session stage
+touch "$EXTRAS_DONE"
+exit "$session_rc"

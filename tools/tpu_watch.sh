@@ -1,43 +1,60 @@
 #!/bin/bash
-# Probe the axon TPU tunnel on a timer and FIRE the round-4 evidence
-# session (tools/tpu_round4.sh) each time a probe succeeds, until the
+# Probe the axon TPU tunnel on a timer and FIRE the round-5 evidence
+# session (tools/tpu_round5.sh) each time a probe succeeds, until the
 # session completes rc=0 (every stage landed ok — already-landed stages
 # are skipped inside tpu_session.py, so each fire only runs what is
 # still missing). Run detached:
-#   nohup bash tools/tpu_watch.sh > benchmarks/results/round4_watch.log 2>&1 &
+#   nohup bash tools/tpu_watch.sh > benchmarks/results/round5_watch.log 2>&1 &
 # A lockfile prevents double-firing if a manual session is also started.
+# The lock is acquired ATOMICALLY (noclobber create) BEFORE the session
+# launches, so two watchers racing the same check-then-write window can't
+# both fire (round-4 advisor finding).
 set -u
 cd "$(dirname "$0")/.."
-LOCK=benchmarks/results/.r4_session_running
+LOCK=benchmarks/results/.r5_session_running
 MAX_FIRES=8   # a stage broken for real (not a wedge) must not spin forever
 fires=0
 PROBE='import jax, jax.numpy as jnp
 x = jnp.ones((8, 128)); (x @ x.T).sum().block_until_ready()
 print(jax.devices()[0].platform)'
 
-while true; do
-  if [ -f "$LOCK" ]; then
+take_lock() {
+  # atomic create-or-fail; on failure inspect the holder and clear only
+  # a provably dead one, then retry exactly once
+  for _ in 1 2; do
+    if (set -C; echo "$$" > "$LOCK") 2>/dev/null; then
+      return 0
+    fi
     holder=$(cat "$LOCK" 2>/dev/null)
     if [ -n "$holder" ] && kill -0 "$holder" 2>/dev/null; then
-      echo "$(date -u +%FT%TZ) session already running (pid $holder); watcher exiting"
-      exit 0
+      return 1   # live holder (another watcher or a manual session)
     fi
     # holder died without cleanup (SIGKILL / reboot): a dead lock must
     # not silently disable the retry-until-done loop
     echo "$(date -u +%FT%TZ) stale lock (pid ${holder:-none} gone); clearing"
     rm -f "$LOCK"
-  fi
+  done
+  return 1
+}
+
+while true; do
   if timeout 90 python -c "$PROBE" 2>/dev/null | grep -q tpu; then
+    if ! take_lock; then
+      echo "$(date -u +%FT%TZ) lock held by live pid $(cat "$LOCK" 2>/dev/null); watcher exiting"
+      exit 0
+    fi
     fires=$((fires + 1))
     if [ "$fires" -gt "$MAX_FIRES" ]; then
+      rm -f "$LOCK"
       echo "$(date -u +%FT%TZ) fire cap ($MAX_FIRES) reached; watcher done"
       exit 1
     fi
-    echo "$(date -u +%FT%TZ) PROBE OK — firing tpu_round4.sh (fire $fires)"
-    # the lock holds the SESSION's pid, not the watcher's: if the watcher
-    # is SIGKILLed the session child survives, and a restarted watcher
-    # must see the lock as live until that session actually exits
-    bash tools/tpu_round4.sh &
+    echo "$(date -u +%FT%TZ) PROBE OK — firing tpu_round5.sh (fire $fires)"
+    # the lock holds the SESSION's pid once launched (if the watcher is
+    # SIGKILLed the session child survives, and a restarted watcher must
+    # see the lock as live until that session actually exits); the
+    # atomic placeholder above held our own pid during the launch gap
+    bash tools/tpu_round5.sh &
     echo "$!" > "$LOCK"
     wait "$!"
     rc=$?
