@@ -46,18 +46,21 @@ fallback line; see also the signal write-ahead below).
    FakeLLM candidates lowered to VM register programs and run as one
    segmented batched launch — reported as ``code_evals_per_sec`` in the
    same JSON line (the apples-to-apples answer to the reference's ~40
-   code-candidate evals/s/host). Never fails the bench; falls back to
-   the freshest session-recorded code measurement.
+   code-candidate evals/s/host). Runs sharded over the population mesh
+   when >1 device is visible. Never fails the bench; falls back to the
+   CURRENT round's session-recorded code measurement.
 
 Stages run as ``python bench.py --stage parity|throughput|codetput``
 (argv, not env, so a leaked variable can't turn the top-level run into a
 bare stage).
 
-Fallback contract (round 5): when the device probe fails, the fallback
-line BANKS the freshest measurement recorded by the round's TPU session
-(benchmarks/results/round*_tpu.jsonl) with full provenance, instead of
-printing value 0.0 with a stale note — rounds 3 and 4 both recorded 0.0
-headlines while holding live same-round measurements (VERDICT r4 weak #1).
+Fallback contract (round 6): when the device probe fails, the headline
+``value``/``vs_baseline`` stay 0.0 (nothing was measured THIS run), and
+the CURRENT round's TPU-session measurement — never a prior round's —
+rides along under ``banked_from`` with full provenance
+(benchmarks/results/round*_tpu.jsonl, highest round number only). Round
+5's variant promoted banked numbers into the headline, which a prior
+round's stale file could silently feed.
 
 Contract hardening (round 3): the controller installs SIGTERM/SIGINT/
 SIGHUP handlers that print the fallback JSON line before exiting, so even
@@ -89,32 +92,36 @@ _RESULT_PRINTED = False
 
 
 def _banked_measurement():
-    """Freshest session-recorded measurement of the headline metric.
+    """CURRENT-round session-recorded measurement of the headline metric.
 
     The TPU measurement session (tools/tpu_session.py) appends every
     stage result to benchmarks/results/round*_tpu.jsonl as it lands.
     When this bench run cannot reach the device (the axon tunnel wedges
     for hours at a time), the round's evidence still exists in that file
     — rounds 3 and 4 both recorded 0.0 headlines while holding live
-    same-round measurements (VERDICT r4 weak #1). Returns
-    ``(headline_record, code_record)`` — the BEST parametric-population
-    evals/s from the newest session file that has any, and the best
-    code-candidate evals/s — either possibly None.
+    same-round measurements (VERDICT r4 weak #1). Only the HIGHEST round
+    number's file is scanned: a prior round's number is that round's
+    evidence, not this one's, and surfacing it as if current overstated
+    the fallback in round 5. Returns ``(headline_record, code_record)``
+    — the best parametric-population evals/s and the best code-candidate
+    evals/s from the current round's file — either possibly None.
     """
     import glob
+    import re
     results = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                            "benchmarks", "results")
 
-    def _mtime(p):
-        try:
-            return os.path.getmtime(p)
-        except OSError:
-            return 0.0  # racing writer/cleaner: sort it last, still scanned
+    def _round_no(p):
+        m = re.search(r"round(\d+)_tpu\.jsonl$", os.path.basename(p))
+        return int(m.group(1)) if m else -1
+
+    paths = glob.glob(os.path.join(results, "round*_tpu.jsonl"))
+    current = max((_round_no(p) for p in paths), default=-1)
+    if current < 0:
+        return None, None
 
     best = code_best = None
-    for path in sorted(glob.glob(os.path.join(results, "round*_tpu.jsonl")),
-                       key=_mtime, reverse=True):
-        file_best = file_code = None
+    for path in (p for p in paths if _round_no(p) == current):
         try:
             with open(path) as f:
                 lines = f.readlines()
@@ -133,32 +140,26 @@ def _banked_measurement():
             if (rec.get("stage") in _BANKABLE_STAGES
                     and isinstance(res.get("evals_per_sec"), (int, float))):
                 v = float(res["evals_per_sec"])
-                if file_best is None or v > file_best["value"]:
-                    file_best = {"value": v, **src,
-                                 "truncated": res.get("truncated")}
+                if best is None or v > best["value"]:
+                    best = {"value": v, **src,
+                            "truncated": res.get("truncated")}
             # vmbatch partial rows land as stage vmbatch_pop{N}
             cv = res.get("code_evals_per_sec", rec.get("code_evals_per_sec"))
             if isinstance(cv, (int, float)) and cv > 0:
-                if file_code is None or float(cv) > file_code["value"]:
-                    file_code = {"value": float(cv), **src}
-        # each metric banks from the NEWEST file that has it — they scan
-        # independently, since a partially-landed session (e.g. vmbatch
-        # landed, flat didn't) must not blank the other metric's history
-        if best is None and file_best is not None:
-            best = file_best
-        if code_best is None and file_code is not None:
-            code_best = file_code
-        if best is not None and code_best is not None:
-            break
+                if code_best is None or float(cv) > code_best["value"]:
+                    code_best = {"value": float(cv), **src}
     return best, code_best
 
 
 def _fallback_json(error: str) -> str:
-    """The benchmark's single-JSON-line contract, error form. Instead of
-    a 0.0 with a hand-written note (rounds 3/4's failure mode), the value
-    BANKS the freshest session-recorded measurement of the same metric,
-    with full provenance — an infrastructure failure must not erase the
-    round's evidence. 0.0 only when no session ever measured anything.
+    """The benchmark's single-JSON-line contract, error form. The
+    headline ``value``/``vs_baseline`` stay 0.0 — a failed probe measured
+    nothing, and a banked number in the headline reads as a live result
+    to the take-the-JSON-line driver (rounds 3-5 oscillated between the
+    two failure modes). The current round's session-recorded measurement,
+    when one exists, rides along UNDER ``banked_from`` with full
+    provenance, so the round's evidence is preserved without being
+    mislabeled.
 
     This runs inside the kill-signal write-ahead handler, so the banked
     lookup is fully guarded: a filesystem race there must not cost the
@@ -170,20 +171,15 @@ def _fallback_json(error: str) -> str:
     payload = {"metric": METRIC, "value": 0.0, "unit": "evals/s",
                "vs_baseline": 0.0, "error": error}
     if banked is not None:
-        payload.update({
-            "value": round(banked["value"], 2),
-            "vs_baseline": round(banked["value"] / BASELINE_EVALS_PER_SEC, 3),
-            "source": "banked session measurement (no live probe this run)",
-            "banked_from": banked,
-        })
+        payload["banked_from"] = banked
+        payload["note"] = ("no live probe this run; the current round's "
+                           "session measurement is reported under "
+                           "banked_from only")
     else:
         payload["note"] = ("no live measurement this run and no recorded "
-                           "session measurement found in "
-                           "benchmarks/results/round*_tpu.jsonl")
+                           "session measurement found in the current "
+                           "round's benchmarks/results/round*_tpu.jsonl")
     if code_banked is not None:
-        payload["code_evals_per_sec"] = round(code_banked["value"], 2)
-        payload["code_vs_reference_40eps"] = round(
-            code_banked["value"] / BASELINE_EVALS_PER_SEC, 3)
         payload["code_banked_from"] = code_banked
     return json.dumps(payload)
 
@@ -400,16 +396,22 @@ def stage_throughput(pop: int, chunk: int, reps: int, engine: str) -> int:
 
 def stage_codetput() -> int:
     """Device subprocess: CODE-candidate throughput — a generation of
-    FakeLLM candidates lowered to VM register programs on the host and
-    evaluated as one segmented batched launch (the apples-to-apples
-    answer to the reference's ~40 evals/s/host ProcessPool fan-out,
-    reference: funsearch/funsearch_integration.py:535-562). Prints one
-    JSON line {"code_evals_per_sec": ...}."""
+    FakeLLM candidates lowered to VM register programs on the host
+    (``vm.lower_fake_candidates``, the shared candidate source with the
+    TPU session's vmbatch stage) and evaluated as one segmented batched
+    launch, SHARDED over the population mesh when more than one device is
+    visible (the apples-to-apples answer to the reference's ~40
+    evals/s/host ProcessPool fan-out, reference:
+    funsearch/funsearch_integration.py:535-562). Prints one JSON line
+    {"code_evals_per_sec": ...}."""
     import jax
     import numpy as np
 
     from fks_tpu.data import TraceParser
-    from fks_tpu.funsearch import llm, template, vm
+    from fks_tpu.funsearch import vm
+    from fks_tpu.parallel import (
+        make_sharded_code_eval, pad_population, population_mesh,
+    )
     from fks_tpu.sim import flat
     from fks_tpu.sim.engine import SimConfig
 
@@ -417,38 +419,48 @@ def stage_codetput() -> int:
     cap = 256
     wl = TraceParser().parse_workload()
     cfg = SimConfig(max_steps=4 * wl.num_pods, track_ctime=False)
-    n, g = wl.cluster.n_padded, wl.cluster.g_padded
-    fake = llm.FakeLLM(seed=7, junk_rate=0.0)
-    progs = []
-    for _ in range(24 * pop):  # bounded: junk candidates are skipped
-        if len(progs) >= 2 * pop:
-            break
-        code = template.fill_template(fake.complete("x"))
-        try:
-            progs.append(vm.compile_policy(code, n, g, capacity=cap))
-        except Exception:  # noqa: BLE001 — outside the VM vocabulary
-            continue
+    progs, _ = vm.lower_fake_candidates(
+        wl.cluster.n_padded, wl.cluster.g_padded, 2 * pop, capacity=cap)
     if len(progs) < 2 * pop:
         log(f"only {len(progs)} VM-able candidates (need {2 * pop})")
         return 1
-    # segmented: no single device call outlives the tunnel's ~60 s
-    # execution kill window
-    run = flat.make_segmented_population_run(wl, vm.score_static, cfg,
-                                             seg_steps=4096)
-    state0 = flat.initial_state(wl, cfg)
+    # segmented either way: no single device call outlives the tunnel's
+    # ~60 s execution kill window
+    devices = jax.devices()
+    if len(devices) > 1:
+        mesh = population_mesh(devices)
+        sharded = make_sharded_code_eval(wl, mesh, cfg=cfg,
+                                         elite_k=min(8, pop),
+                                         engine="flat", seg_steps=4096)
+
+        def run(stacked):
+            padded, real = pad_population(stacked, mesh)
+            return sharded(padded, real)[0]
+
+        mode = f"sharded over {len(devices)} devices"
+    else:
+        seg = flat.make_segmented_population_run(wl, vm.score_static, cfg,
+                                                 seg_steps=4096)
+        state0 = flat.initial_state(wl, cfg)
+
+        def run(stacked):
+            return seg(stacked, state0)
+
+        mode = "vmap on 1 device"
+    log(f"code throughput mode: {mode}")
     t0 = time.perf_counter()
-    res = run(vm.stack_programs(progs[:pop], capacity=cap), state0)
+    res = run(vm.stack_programs(progs[:pop], capacity=cap))
     jax.block_until_ready(res.policy_score)
     log(f"first launch (compile+run): {time.perf_counter() - t0:.1f}s")
     batch = vm.stack_programs(progs[pop:2 * pop], capacity=cap)
     t0 = time.perf_counter()
-    res = run(batch, state0)
+    res = run(batch)
     jax.block_until_ready(res.policy_score)
     best = time.perf_counter() - t0
-    n_trunc = int(np.asarray(res.truncated).sum())
+    n_trunc = int(np.asarray(res.truncated)[:pop].sum())
     log(f"steady-state: {best:.3f}s / {pop} code evals "
         f"(truncated {n_trunc}/{pop})")
-    print(json.dumps({"code_evals_per_sec": pop / best}))
+    print(json.dumps({"code_evals_per_sec": pop / best, "mode": mode}))
     return 0
 
 

@@ -316,7 +316,9 @@ def cmd_scale(args):
     """Synthetic scale run (BASELINE.json config 5 shape): N-node x P-pod
     generated trace, population-parallel evaluation, throughput report.
     Uses the device mesh when more than one device is visible, plain vmap
-    otherwise."""
+    otherwise. ``--code-pop N`` additionally measures the VM
+    code-candidate tier (FakeLLM candidates lowered to register programs,
+    sharded over the same mesh via make_sharded_code_eval)."""
     _apply_platform_flags(args)
     import jax
 
@@ -370,6 +372,44 @@ def cmd_scale(args):
             "score_min": round(float(scores.min()), 4),
             "score_max": round(float(scores.max()), 4),
         }
+        if getattr(args, "code_pop", 0) > 0:
+            from fks_tpu.funsearch import vm
+            from fks_tpu.parallel import make_sharded_code_eval
+            from fks_tpu.sim import get_engine
+
+            # the fused kernel evaluates parametric weights only; the VM
+            # tier runs on the interpreter engines
+            code_engine = "flat" if args.engine == "fused" else args.engine
+            c = wl.cluster
+            progs, _ = vm.lower_fake_candidates(
+                c.n_padded, c.g_padded, args.code_pop, capacity=256)
+            if len(progs) < args.code_pop:
+                print(f"error: FakeLLM lowered only {len(progs)} VM "
+                      f"candidates; lower --code-pop", file=sys.stderr)
+                return 2
+            stacked = vm.stack_programs(progs[: args.code_pop])
+            if len(devices) > 1:
+                cpadded, creal = pad_population(stacked, mesh)
+                cev = make_sharded_code_eval(
+                    wl, mesh, cfg=cfg, elite_k=min(4, args.code_pop),
+                    engine=code_engine)
+                with timed("code eval") as ct:
+                    cres = ct.sync(cev(cpadded, creal)[0])
+            else:
+                mod = get_engine(code_engine)
+                crun = mod.make_population_run_fn(wl, vm.score_static, cfg)
+                with timed("code eval") as ct:
+                    cres = ct.sync(crun(stacked, mod.initial_state(wl, cfg)))
+            cscores = cres.policy_score[: args.code_pop]
+            cmeter = ThroughputMeter()
+            cmeter.add(args.code_pop, ct.seconds)
+            out.update({
+                "code_population": args.code_pop,
+                "code_engine": code_engine,
+                "code_wall_s": round(ct.seconds, 3),
+                "code_evals_per_sec": round(cmeter.rate, 3),
+                "code_score_max": round(float(cscores.max()), 4),
+            })
         if metrics:
             metrics.write("scale", out)
     print(json.dumps(out, indent=2))
@@ -445,6 +485,10 @@ def main(argv=None) -> int:
     sc.add_argument("--pods-count", type=int, default=100000)
     sc.add_argument("--pop", type=int, default=8)
     sc.add_argument("--seed", type=int, default=0)
+    sc.add_argument("--code-pop", type=int, default=0,
+                    help="also measure the VM code-candidate tier with N "
+                         "FakeLLM-lowered register programs (0 = off); "
+                         "sharded over the mesh when >1 device is visible")
     sc.add_argument("--devices", type=int, default=0,
                     help="with --cpu: number of virtual CPU devices to "
                          "mesh over (otherwise scale silently runs "

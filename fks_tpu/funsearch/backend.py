@@ -19,7 +19,10 @@ Three throughput tiers:
 - VM candidates (default): the candidate's jaxpr is lowered to a register
   program (fks_tpu.funsearch.vm) and interpreted by ONE engine program
   compiled once per evaluator — a fresh candidate costs a device run, not
-  an XLA compile;
+  an XLA compile; with ``mesh=`` (a >1-device population mesh) the stacked
+  generation is SHARDED over the mesh via
+  fks_tpu.parallel.mesh.make_sharded_code_eval, each device interpreting
+  its shard of the batch;
 - jit candidates (fallback): one compiled program per unique AST, for the
   rare candidate outside the VM vocabulary;
 - parametric candidates: one program TOTAL for the whole population
@@ -72,7 +75,8 @@ class CodeEvaluator:
 
     def __init__(self, workload: Workload, cfg: SimConfig = SimConfig(),
                  max_workers: Optional[int] = None, use_vm: bool = True,
-                 engine: str = "exact", vm_batch: Optional[bool] = None):
+                 engine: str = "exact", vm_batch: Optional[bool] = None,
+                 mesh=None):
         from fks_tpu.sim import get_engine
 
         self.workload = workload
@@ -88,16 +92,26 @@ class CodeEvaluator:
         self.use_vm = use_vm
         self._vm_run = None  # lazily built shared engine program
         self._vm_pop_run = None  # lazily built POPULATION engine program
+        self._vm_mesh_run = None  # lazily built SHARDED population program
         self.vm_batch_count = 0  # observability: batched VM launches
+        # Mesh-sharded batched tier: with a >1-device mesh each device
+        # interprets its shard of the stacked generation
+        # (parallel.mesh.make_sharded_code_eval) — the jit/parametric
+        # tiers and single-device behavior are unchanged.
+        self.mesh = mesh
+        from fks_tpu.parallel.mesh import num_shards
+        self._n_shards = num_shards(mesh) if mesh is not None else 1
         # Batched VM evaluation: under vmap the interpreter's lax.switch
         # over a per-lane opcode executes ALL ~40 branches and selects.
         # On TPU each branch is one elementwise vreg op — noise next to
         # the engine step — so a generation as ONE launch wins; on a CPU
         # host the same 40x op fan-out runs serially and loses badly to
         # the sequential unbatched VM tier. Auto: batch iff the default
-        # backend is an accelerator.
+        # backend is an accelerator — or a multi-device mesh was passed,
+        # which only the batched tier can use.
         if vm_batch is None:
-            vm_batch = jax.default_backend() != "cpu"
+            vm_batch = (jax.default_backend() != "cpu"
+                        or self._n_shards > 1)
         self.vm_batch = vm_batch
         # Bounded device-call length for the batched tier (flat engine
         # only): the axon TPU tunnel kills single device executions over
@@ -166,20 +180,35 @@ class CodeEvaluator:
                         self.workload, vm.score_static, self.cfg))
         return self._vm_pop_run
 
+    def _vm_mesh_runner(self):
+        if self._vm_mesh_run is None:
+            from fks_tpu.parallel.mesh import make_sharded_code_eval
+            self._vm_mesh_run = make_sharded_code_eval(
+                self.workload, self.mesh, cfg=self.cfg, elite_k=1,
+                engine=self.engine, seg_steps=self.vm_seg_steps)
+        return self._vm_mesh_run
+
     def _run_vm_batch(self, progs: List[vm.VMProgram]) -> List[SimResult]:
-        """Evaluate stacked VM candidates in ONE device launch.
+        """Evaluate stacked VM candidates in ONE device launch — sharded
+        over the mesh when one with >1 device was passed.
 
         Shapes are bucketed (capacity to the stack's power-of-two, the
-        population axis to the next power of two, padded by repeating the
-        last program) so the jitted population runner retraces only per
-        bucket, never per generation. Replaces the reference's
-        one-subprocess-per-candidate fan-out
+        population axis to the next power of two rounded to the shard
+        count, padded by repeating the last program) so the jitted
+        population runner retraces only per bucket, never per generation.
+        Replaces the reference's one-subprocess-per-candidate fan-out
         (funsearch_integration.py:535-562) with one XLA program.
         """
-        pop = max(1, 1 << (len(progs) - 1).bit_length())
+        pop = vm.bucket_lanes(len(progs), self._n_shards)
         padded = list(progs) + [progs[-1]] * (pop - len(progs))
         stacked = vm.stack_programs(padded)
-        result = self._vm_pop_runner()(stacked, self.state0)
+        if self._n_shards > 1:
+            # each device interprets pop/shards lanes; the elite outputs
+            # are discarded here (the evolution loop ranks on the host,
+            # where admission/dedup live)
+            result, _, _ = self._vm_mesh_runner()(stacked, len(progs))
+        else:
+            result = self._vm_pop_runner()(stacked, self.state0)
         # ONE device->host transfer for the whole generation: slicing lazy
         # device arrays would cost ~3 tiny syncs per lane in _record
         result = jax.device_get(result)

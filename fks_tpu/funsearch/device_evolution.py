@@ -136,9 +136,7 @@ class ParametricEvolution:
         size), this lets a NEW population geometry continue from a saved
         champion. Preserves the mesh sharding and pad-lane masking
         (``real_count`` is untouched)."""
-        from jax.sharding import NamedSharding, PartitionSpec as P
-
-        from fks_tpu.parallel.mesh import _pop_axes
+        from fks_tpu.parallel import shard_population
 
         champ = jnp.asarray(weights, self.params.dtype)
         if champ.shape != tuple(self.params.shape[1:]):
@@ -149,17 +147,13 @@ class ParametricEvolution:
         key = jax.random.PRNGKey(seed)
         perturbed = champ[None, :] + noise * jax.random.normal(
             key, self.params.shape, self.params.dtype)
-        self.params = jax.device_put(
-            perturbed.at[0].set(champ),
-            NamedSharding(self.mesh, P(_pop_axes(self.mesh))))
+        self.params = shard_population(perturbed.at[0].set(champ), self.mesh)
 
     def restore_checkpoint(self, path: str) -> None:
         """Restore onto an instance built with the SAME workload/mesh/
         engine/pop_size; continuing reproduces the uninterrupted run
         exactly (same key-split sequence)."""
-        from jax.sharding import NamedSharding, PartitionSpec as P
-
-        from fks_tpu.parallel.mesh import _pop_axes
+        from fks_tpu.parallel import shard_population
 
         if not path.endswith(".npz"):  # mirror save_checkpoint's normalize
             path += ".npz"
@@ -170,9 +164,8 @@ class ParametricEvolution:
                     f"this instance's {tuple(self.params.shape)}")
             # re-establish the mesh sharding (every process holds the full
             # array, so device_put builds the same global array everywhere)
-            self.params = jax.device_put(
-                jnp.asarray(d["params"]),
-                NamedSharding(self.mesh, P(_pop_axes(self.mesh))))
+            self.params = shard_population(jnp.asarray(d["params"]),
+                                           self.mesh)
             self._key = jnp.asarray(d["key"])
             self.generation = int(d["generation"])
             self.best_score = float(d["best_score"])

@@ -201,8 +201,10 @@ class FunSearch:
         if window <= 1:
             return
         head = self.population[:window]
-        # exact first, search fitness as the tie-break (it also orders any
-        # transiently failed rescores, which return 0.0 un-memoized)
+        # exact first, search fitness as the tie-break; a transiently
+        # failed rescore falls back to the member's search fitness
+        # (un-memoized), so an infrastructure hiccup cannot evict a true
+        # champion from the head window
         head.sort(key=lambda m: (self._exact_score(m[0], m[1]), m[1]),
                   reverse=True)
         self.population[:window] = head
@@ -226,8 +228,10 @@ class FunSearch:
         the search engine already IS exact; otherwise one VM-tier (or
         cached-jit) run of fks_tpu.sim.engine, memoized per canonical AST
         so NEW-BEST logging and the save paths never re-simulate the same
-        candidate. A failed rescore maps to 0.0 — same rule the reference
-        applies to any failed evaluation (reference:
+        candidate. A transiently failed rescore falls back to ``score``
+        (the member's search fitness, un-memoized, retried next call);
+        only an unparseable candidate maps to 0.0 — the rule the
+        reference applies to failed evaluations (reference:
         funsearch_integration.py:63-64)."""
         if self.evaluator.engine == "exact":
             return score
@@ -251,16 +255,19 @@ class FunSearch:
                         self.evaluator.workload, self.evaluator.cfg,
                         engine="exact")
                 exact = self._exact_eval.evaluate_one(code).score
-        except Exception as e:  # noqa: BLE001 — the stated rule: a failed
-            # rescore maps to 0.0; it must never kill the evolve loop
-            # mid-generation (evaluate_one catches candidate failures, but
-            # evaluator construction itself can raise). NOT memoized: an
-            # infrastructure failure here is transient, and pinning the
-            # champion's exact fitness to 0.0 for the rest of the run
-            # would outlive it.
+        except Exception as e:  # noqa: BLE001 — a transient infrastructure
+            # failure (evaluate_one catches candidate failures, but
+            # evaluator construction itself can raise) must never kill the
+            # evolve loop mid-generation. Fall back to the member's SEARCH
+            # fitness: ranking on (exact if ok else search, search) keeps a
+            # true champion inside the elite window, where a 0.0 would
+            # evict it — and the head window would then aim selection
+            # pressure away from the best member for the rest of the run.
+            # NOT memoized: the failure is transient; the next _sort
+            # retries the exact rescore.
             self.log(f"  exact rescore failed ({type(e).__name__}: {e}); "
-                     "fitness 0.0")
-            return 0.0
+                     f"falling back to search fitness {score:.4f}")
+            return score
         self._exact_memo[key] = exact
         return exact
 

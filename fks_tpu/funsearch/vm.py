@@ -704,6 +704,18 @@ def stack_programs(progs: Sequence[VMProgram],
     return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *padded)
 
 
+def bucket_lanes(n: int, multiple: int = 1) -> int:
+    """Lane count for a batch of ``n`` programs: the next power of two
+    (so the jitted population runner retraces per BUCKET, never per
+    generation), rounded up to a multiple of ``multiple`` — the mesh
+    shard count, so a stacked batch divides evenly over the population
+    shards. For power-of-two shard counts (every real topology) the
+    round-up is absorbed by the bucket and the bucket set is unchanged.
+    """
+    pop = max(1, 1 << (max(1, n) - 1).bit_length())
+    return -(-pop // multiple) * multiple
+
+
 def lower_fake_candidates(n: int, g: int, need: int, *, capacity: int = 256,
                           seed: int = 7, max_tries_factor: int = 12):
     """Generate + lower ``need`` FakeLLM candidates to VM programs.

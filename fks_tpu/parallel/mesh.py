@@ -40,6 +40,7 @@ from fks_tpu.data.entities import Workload
 from fks_tpu.models import parametric
 from fks_tpu.parallel.population import ParamPolicyFn
 from fks_tpu.sim.engine import SimConfig, initial_state, make_population_run_fn
+from fks_tpu.utils.compat import shard_map
 
 POP_AXIS = "pop"
 DCN_AXIS = "dcn"
@@ -72,7 +73,8 @@ def init_distributed(coordinator_address: Optional[str] = None,
     """
     explicit = any(v is not None
                    for v in (coordinator_address, num_processes, process_id))
-    if not jax.distributed.is_initialized():
+    from fks_tpu.utils.compat import distributed_is_initialized
+    if not distributed_is_initialized():
         try:
             jax.distributed.initialize(
                 coordinator_address=coordinator_address,
@@ -109,11 +111,15 @@ def _pop_axes(mesh: Mesh):
     return tuple(a for a in mesh.axis_names if a in (DCN_AXIS, POP_AXIS))
 
 
-def _num_shards(mesh: Mesh) -> int:
+def num_shards(mesh: Mesh) -> int:
+    """Total population shards: the product of the mesh's pop axes."""
     n = 1
     for a in _pop_axes(mesh):
         n *= mesh.shape[a]
     return n
+
+
+_num_shards = num_shards  # internal alias, kept for existing call sites
 
 
 def _shard_index(mesh: Mesh):
@@ -125,29 +131,44 @@ def _shard_index(mesh: Mesh):
     return idx
 
 
-def pad_population(params: jax.Array, num_shards):
+def pad_population(params, num_shards):
     """Pad C up to a multiple of the shard count (pass the mesh itself or an
     int); returns (padded, real_count).
 
-    Pass ``real_count`` back into the sharded eval so pad slots (duplicates
-    of the last candidate) are masked out of elite selection.
+    ``params`` is any pytree whose every leaf carries the candidate axis as
+    its LEADING dimension — a parametric weight matrix ``[C, F]`` or a
+    ``vm.stack_programs`` batch alike. Padding replicates the last
+    candidate's slice on every leaf. Pass ``real_count`` back into the
+    sharded eval so pad slots (duplicates of the last candidate) are masked
+    out of elite selection.
     """
     if isinstance(num_shards, Mesh):
         num_shards = _num_shards(num_shards)
-    c = params.shape[0]
+    c = jax.tree_util.tree_leaves(params)[0].shape[0]
     target = -(-c // num_shards) * num_shards
     if target != c:
-        pad = jnp.tile(params[-1:], (target - c,) + (1,) * (params.ndim - 1))
-        params = jnp.concatenate([params, pad], axis=0)
+        def _pad_leaf(x):
+            pad = jnp.tile(x[-1:], (target - c,) + (1,) * (x.ndim - 1))
+            return jnp.concatenate([x, pad], axis=0)
+
+        params = jax.tree_util.tree_map(_pad_leaf, params)
     return params, c
 
 
-def _shard_params(params: jax.Array, mesh: Mesh) -> jax.Array:
-    if params.shape[0] % _num_shards(mesh):
+def shard_population(params, mesh: Mesh):
+    """``device_put`` every leaf of a candidate pytree with its leading
+    (candidate) axis sharded over the mesh's pop axes. Identity layout for
+    a bare ``jax.Array`` population — the historical fast path — and the
+    generic entry for pytree payloads (stacked VM programs)."""
+    c = jax.tree_util.tree_leaves(params)[0].shape[0]
+    if c % _num_shards(mesh):
         raise ValueError(
-            f"population {params.shape[0]} not divisible by shard count "
+            f"population {c} not divisible by shard count "
             f"{_num_shards(mesh)}; use pad_population()")
     return jax.device_put(params, NamedSharding(mesh, P(_pop_axes(mesh))))
+
+
+_shard_params = shard_population  # internal alias, kept for call sites
 
 
 def _global_scores(run, state0, params_shard, axes):
@@ -180,7 +201,9 @@ def _top_k_real(global_scores, real_count, k):
 # NOTE on check_vma=False: the engine's inner heap loops mix invariant
 # literals into varying carries; the varying-manual-axes audit rejects that
 # even though the program is correct. Correctness of the sharded path is
-# covered by the sharded-vs-vmap parity tests instead.
+# covered by the sharded-vs-vmap parity tests instead. (On jax 0.4.x the
+# same audit is spelled check_rep — the fks_tpu.utils.compat shim
+# translates.)
 
 
 def _engine_runner(workload, param_policy, cfg, engine):
@@ -214,7 +237,7 @@ def make_sharded_eval(workload: Workload, mesh: Mesh,
     axes = _pop_axes(mesh)
 
     @functools.partial(
-        jax.shard_map, mesh=mesh,
+        shard_map, mesh=mesh,
         in_specs=(P(axes), P()),
         out_specs=(P(axes), P(), P()),
         check_vma=False,
@@ -256,7 +279,7 @@ def make_sharded_generation_step(workload: Workload, mesh: Mesh,
     axes = _pop_axes(mesh)
 
     @functools.partial(
-        jax.shard_map, mesh=mesh,
+        shard_map, mesh=mesh,
         in_specs=(P(axes), P(), P()),
         out_specs=(P(axes), P(axes), P()),
         check_vma=False,
@@ -288,3 +311,149 @@ def make_sharded_generation_step(workload: Workload, mesh: Mesh,
         return gen_step(params, key, jnp.asarray(real_count, jnp.int32))
 
     return jax.jit(step)
+
+
+def make_sharded_code_eval(workload: Workload, mesh: Mesh,
+                           cfg: SimConfig = SimConfig(),
+                           elite_k: int = 8, engine: str = "exact",
+                           seg_steps: int = 0):
+    """Build ``eval(stacked, real_count) -> (result, elite_idx[K],
+    elite_scores[K])`` for STACKED VM code candidates — the code-candidate
+    analogue of ``make_sharded_eval``.
+
+    ``stacked`` is a ``vm.stack_programs`` batch; its candidate count must
+    be a multiple of the mesh size (use ``pad_population``, which is
+    pytree-generic, and forward ``real_count`` so pad duplicates are
+    excluded from the elite ranking). Inside ``shard_map`` each device
+    interprets its shard of the program batch through the population
+    engine (``vm.score_static`` — one compiled program for the whole VM
+    vocabulary, zero per-candidate XLA compiles), then the fitness vector
+    is all-gathered over the pop axes so every device computes the
+    identical global top-k. This closes the gap between the parametric
+    tier (mesh-wide since the seed) and the headline FunSearch workload,
+    which previously vmapped on one device (backend._run_vm_batch).
+
+    ``result`` is the full per-candidate ``SimResult`` (sharded over the
+    pop axes): the backend's failure semantics need ``failed``/
+    ``truncated``/``policy_score``, not a bare fitness vector.
+
+    ``seg_steps > 0`` bounds each device call to ~``seg_steps`` events per
+    dispatch (the FKS_VM_SEG_STEPS contract, for runtimes that kill long
+    device executions); engines without segmented internals fall back to
+    the single-dispatch path.
+    """
+    from fks_tpu.funsearch import vm
+    from fks_tpu.sim import get_engine
+
+    mod = get_engine(engine)
+    if seg_steps > 0 and hasattr(mod, "make_segmented_population_run"):
+        return _make_segmented_code_eval(workload, mesh, cfg, elite_k, mod,
+                                         seg_steps)
+
+    run = mod.make_population_run_fn(workload, vm.score_static, cfg)
+    state0 = mod.initial_state(workload, cfg)
+    axes = _pop_axes(mesh)
+
+    @functools.partial(
+        shard_map, mesh=mesh,
+        in_specs=(P(axes), P()),
+        out_specs=(P(axes), P(), P()),
+        check_vma=False,
+    )
+    def shard_eval(progs_shard, real_count):
+        res = run(progs_shard, state0)
+        global_scores = jax.lax.all_gather(res.policy_score, axes,
+                                           tiled=True)
+        elite_scores, elite_idx = _top_k_real(global_scores, real_count,
+                                              elite_k)
+        return res, elite_idx, elite_scores
+
+    def sharded_eval(stacked, real_count=None):
+        stacked = shard_population(stacked, mesh)
+        if real_count is None:
+            real_count = jax.tree_util.tree_leaves(stacked)[0].shape[0]
+        return shard_eval(stacked, jnp.asarray(real_count, jnp.int32))
+
+    return jax.jit(sharded_eval)
+
+
+def _make_segmented_code_eval(workload: Workload, mesh: Mesh, cfg: SimConfig,
+                              elite_k: int, mod, seg_steps: int):
+    """The segmented body of ``make_sharded_code_eval``: a host loop of
+    jitted shard_map'd segments — ``flat.make_segmented_population_run``
+    mirrored one level up, at the mesh. Per segment every shard advances
+    its lanes ~``seg_steps`` events inside a bounded while_loop; one
+    psum'd any-lane-active flag returns to the host, which re-dispatches
+    until every lane on every shard drains (same carry, same segment
+    budget, same divergence guard as the single-device runner)."""
+    from fks_tpu.funsearch import vm
+
+    axes = _pop_axes(mesh)
+    ktable, max_steps = mod.loop_tables(workload, cfg)
+
+    def step_one(prog, s):
+        return mod.build_step(
+            workload, lambda pod, nodes: vm.score_static(prog, pod, nodes),
+            cfg, ktable, max_steps)(s)
+
+    vstep = jax.vmap(step_one, in_axes=(0, 0))
+
+    @jax.jit
+    @functools.partial(
+        shard_map, mesh=mesh,
+        in_specs=(P(axes), P(axes)),
+        out_specs=(P(axes), P()),
+        check_vma=False,
+    )
+    def advance(progs_shard, bstate_shard):
+        start = bstate_shard.steps  # frozen at segment entry
+
+        def cond(s):
+            return jnp.any(mod.lane_active(s, max_steps)
+                           & (s.steps - start < seg_steps))
+
+        out = jax.lax.while_loop(
+            cond, lambda s: vstep(progs_shard, s), bstate_shard)
+        # psum, not all_gather: one scalar per shard, and every device
+        # holds the identical global continue/stop flag
+        local = jnp.any(mod.lane_active(out, max_steps))
+        active = jax.lax.psum(local.astype(jnp.int32), axes) > 0
+        return out, active
+
+    @jax.jit
+    @functools.partial(
+        shard_map, mesh=mesh,
+        in_specs=(P(axes), P()),
+        out_specs=(P(axes), P(), P()),
+        check_vma=False,
+    )
+    def finish(bstate_shard, real_count):
+        res = jax.vmap(lambda s: mod.finalize(workload, cfg, s))(bstate_shard)
+        global_scores = jax.lax.all_gather(res.policy_score, axes,
+                                           tiled=True)
+        elite_scores, elite_idx = _top_k_real(global_scores, real_count,
+                                              elite_k)
+        return res, elite_idx, elite_scores
+
+    state0 = mod.initial_state(workload, cfg)
+
+    def run(stacked, real_count=None):
+        stacked = shard_population(stacked, mesh)
+        pop = jax.tree_util.tree_leaves(stacked)[0].shape[0]
+        if real_count is None:
+            real_count = pop
+        bstate = jax.device_put(mod.broadcast_state(state0, pop),
+                                NamedSharding(mesh, P(_pop_axes(mesh))))
+        active = True
+        for _ in range(-(-max_steps // seg_steps) + 1):
+            bstate, active = advance(stacked, bstate)
+            if not bool(active):  # the only per-segment host sync
+                break
+        if bool(active):
+            raise RuntimeError(
+                "sharded segmented runner exhausted its segment budget "
+                "with lanes still active — cond/step divergence in the "
+                "population engine")
+        return finish(bstate, jnp.asarray(real_count, jnp.int32))
+
+    return run

@@ -211,8 +211,11 @@ def _kernel(plan: _Plan, lanes: int,
                            keepdims=True)
         mask_b = q_iota == sidx                                   # [L,Q]
 
+        # integer reductions pin dtype=i32: under x64 this jax's jnp.sum
+        # widens i32 operands to i64, which the i32 VMEM refs (and real
+        # Mosaic) reject
         aux_s = jnp.sum(jnp.where(mask_b, auxv, 0), axis=1,
-                        keepdims=True)                            # [L,1]
+                        keepdims=True, dtype=jnp.int32)           # [L,1]
         pf = jax.lax.dot_general(
             mask_b.astype(f32), feat_ref[:],
             dimension_numbers=(((1,), (1,)), ((), ())),
@@ -251,7 +254,8 @@ def _kernel(plan: _Plan, lanes: int,
         cpu_util = 1 - cpu_v.astype(d) / cpu_totf
         mem_util = 1 - mem_v.astype(d) / mem_totf
         gpu_count_util = 1 - gpu_v.astype(d) / ngpusf
-        free_milli = jnp.sum(jnp.where(gmask_b, gmil_v, 0), axis=2)
+        free_milli = jnp.sum(jnp.where(gmask_b, gmil_v, 0), axis=2,
+                             dtype=jnp.int32)
         gpu_milli_util = 1 - free_milli.astype(d) / milli_totf
         balance = 1 - jnp.abs(cpu_util - mem_util)
         pod_gpu = pngpu > 0                                       # [L,1]
@@ -260,7 +264,7 @@ def _kernel(plan: _Plan, lanes: int,
             0.0)
         eligible = jnp.sum(
             (gmask_b & (gmil_v >= pmilli[:, :, None])).astype(jnp.int32),
-            axis=2)                                               # [L,N]
+            axis=2, dtype=jnp.int32)                              # [L,N]
         eligible_frac = eligible.astype(d) / ngpusf
         node_has_gpu = (num_gpus > 0).astype(d) + jnp.zeros((L, N), d)
         best_fitf = 1 - (rem_cpu * 0.33 + rem_mem * 0.33 + rem_gpu * 0.34)
@@ -299,7 +303,7 @@ def _kernel(plan: _Plan, lanes: int,
         elig_w = (gmask_b & (gmil_v >= pmilli[:, :, None])
                   & (oh_w[:, :, None] > 0))                       # [L,N,G]
         n_elig = jnp.sum(elig_w.astype(jnp.int32), axis=(1, 2),
-                         keepdims=False)[:, None]                 # [L,1]
+                         keepdims=False, dtype=jnp.int32)[:, None]  # [L,1]
         key = jnp.where(elig_w, gmil_v * G + g_iota3, _BIG)
         sel = jnp.zeros((L, N, G), bool)
         for k in range(G):
@@ -319,7 +323,8 @@ def _kernel(plan: _Plan, lanes: int,
         gmil_v = gmil_v - (oh_p[:, :, None] * pmilli[:, :, None]
                            * sel.astype(jnp.int32))
         new_bits = jnp.sum(
-            jnp.where(sel, jnp.int32(1) << g_iota3, 0), axis=(1, 2))[:, None]
+            jnp.where(sel, jnp.int32(1) << g_iota3, 0), axis=(1, 2),
+            dtype=jnp.int32)[:, None]
 
         # ---- failed creation: waiting histogram + fragmentation + retry
         failp = create & ~placed
@@ -333,7 +338,8 @@ def _kernel(plan: _Plan, lanes: int,
         mn = jnp.where(has_w, mn, 0)
         frag_free = jnp.where(
             gmask_b & (gmil_v > 0) & (gmil_v < mn[:, :, None]), gmil_v, 0)
-        fsum = jnp.sum(frag_free, axis=(1, 2))[:, None]           # [L,1] i32
+        fsum = jnp.sum(frag_free, axis=(1, 2),
+                       dtype=jnp.int32)[:, None]                  # [L,1] i32
         frag_score = jnp.where(
             has_w & (t_gm > 0), fsum.astype(f32) / f32(max(t_gm, 1)),
             f32(0))
@@ -361,14 +367,18 @@ def _kernel(plan: _Plan, lanes: int,
         snap_idx = acci[:, 3:4]
         kt_at = jnp.sum(
             jnp.where(k_iota == jnp.minimum(snap_idx, K - 1), ktable_ref[:],
-                      0), axis=1, keepdims=True)
+                      0), axis=1, keepdims=True, dtype=jnp.int32)
         fire = valid & (snap_idx < K) & (events >= kt_at)
         firef = fire.astype(f32)
-        u_cpu = f32(t_cpu) - jnp.sum(cpu_v, axis=1)[:, None].astype(f32)
-        u_mem = f32(t_mem) - jnp.sum(mem_v, axis=1)[:, None].astype(f32)
-        u_gc = jnp.sum(num_gpus - gpu_v, axis=1)[:, None].astype(f32)
+        u_cpu = f32(t_cpu) - jnp.sum(
+            cpu_v, axis=1, dtype=jnp.int32)[:, None].astype(f32)
+        u_mem = f32(t_mem) - jnp.sum(
+            mem_v, axis=1, dtype=jnp.int32)[:, None].astype(f32)
+        u_gc = jnp.sum(
+            num_gpus - gpu_v, axis=1, dtype=jnp.int32)[:, None].astype(f32)
         u_gm = f32(t_gm) - jnp.sum(
-            jnp.where(gmask_b, gmil_v, 0), axis=(1, 2))[:, None].astype(f32)
+            jnp.where(gmask_b, gmil_v, 0), axis=(1, 2),
+            dtype=jnp.int32)[:, None].astype(f32)
         utils = jnp.concatenate([
             0.0 * u_cpu if t_cpu <= 0 else u_cpu / f32(max(t_cpu, 1)),
             0.0 * u_mem if t_mem <= 0 else u_mem / f32(max(t_mem, 1)),
@@ -381,7 +391,7 @@ def _kernel(plan: _Plan, lanes: int,
         active_nodes = jnp.sum(
             (nmask_b & ((cpu_v < cpu_tot) | (mem_v < mem_tot)
                         | (gpu_v < gpu_dec))).astype(jnp.int32),
-            axis=1)[:, None]
+            axis=1, dtype=jnp.int32)[:, None]
         acci[:, 0:1] = acci[:, 0:1] - (is_del | dropped).astype(jnp.int32)
         acci[:, 1:2] = steps + active.astype(jnp.int32)
         acci[:, 2:3] = events

@@ -3,12 +3,14 @@
 The reference has neither profiler hooks nor ``logging`` (SURVEY.md §5);
 these are framework additions with a reference-compatible metric schema.
 """
+from fks_tpu.utils.compat import distributed_is_initialized, shard_map
 from fks_tpu.utils.logging import MetricsWriter, get_logger, result_record
 from fks_tpu.utils.profiling import (
     ThroughputMeter, Timing, block_timed, device_trace, timed,
 )
 
 __all__ = [
-    "MetricsWriter", "get_logger", "result_record",
+    "MetricsWriter", "distributed_is_initialized", "get_logger",
+    "result_record", "shard_map",
     "ThroughputMeter", "Timing", "block_timed", "device_trace", "timed",
 ]

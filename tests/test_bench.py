@@ -1,0 +1,124 @@
+"""bench.py stage wiring (fast tier): the code-candidate throughput stage
+runs in-process on the conftest 8-virtual-device mesh, and the fallback
+contract only surfaces CURRENT-round session measurements.
+
+The heavy stages (flat/fused parametric throughput) need the full trace
+and are exercised by the TPU measurement session; here the codetput stage
+is routed to the micro workload so its wiring — candidate sourcing via
+``vm.lower_fake_candidates``, the sharded dispatch, the JSON contract —
+stops being device-only code.
+"""
+import json
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:  # `import bench` regardless of pytest rootdir
+    sys.path.insert(0, REPO)
+
+import bench  # noqa: E402
+
+
+class _MicroParser:
+    def __init__(self, wl):
+        self._wl = wl
+
+    def parse_workload(self, *a, **k):
+        return self._wl
+
+
+def test_stage_codetput_sharded_smoke(micro_workload, monkeypatch, capsys):
+    """The stage sources FakeLLM candidates, shards them over the 8-device
+    mesh, and prints the {"code_evals_per_sec": ...} JSON line."""
+    import fks_tpu.data
+
+    monkeypatch.setattr(fks_tpu.data, "TraceParser",
+                        lambda: _MicroParser(micro_workload))
+    monkeypatch.setenv("FKS_BENCH_CODE_POP", "2")
+    assert bench.stage_codetput() == 0
+    out = capsys.readouterr().out
+    payload = json.loads(out.strip().splitlines()[-1])
+    assert payload["code_evals_per_sec"] > 0
+    assert payload["mode"] == "sharded over 8 devices"
+
+
+def test_stage_codetput_gates_on_candidate_count(micro_workload, monkeypatch):
+    """Fewer VM-able candidates than the stage needs -> rc 1 (the
+    controller treats it as a skipped probe), not a crash or a fabricated
+    number."""
+    import fks_tpu.data
+    from fks_tpu.funsearch import vm
+
+    monkeypatch.setattr(fks_tpu.data, "TraceParser",
+                        lambda: _MicroParser(micro_workload))
+    monkeypatch.setattr(vm, "lower_fake_candidates",
+                        lambda *a, **k: ([], []))
+    assert bench.stage_codetput() == 1
+
+
+def _write_round(results_dir, n, records):
+    path = results_dir / f"round{n}_tpu.jsonl"
+    path.write_text("\n".join(json.dumps(r) for r in records) + "\n")
+
+
+@pytest.fixture
+def banked_repo(tmp_path, monkeypatch):
+    """Point bench's results directory at a temp tree (it is derived from
+    the module's __file__)."""
+    results = tmp_path / "benchmarks" / "results"
+    results.mkdir(parents=True)
+    monkeypatch.setattr(bench, "__file__", str(tmp_path / "bench.py"))
+    return results
+
+
+def test_banked_measurement_only_reads_current_round(banked_repo):
+    """A prior round's (higher!) number must not leak into this round's
+    fallback — only the highest-numbered round file is evidence."""
+    _write_round(banked_repo, 5, [
+        {"ok": True, "stage": "flat", "ts": 1,
+         "result": {"evals_per_sec": 999.0}},
+        {"ok": True, "stage": "codetput", "ts": 1,
+         "result": {"code_evals_per_sec": 777.0}},
+    ])
+    _write_round(banked_repo, 6, [
+        {"ok": True, "stage": "flat", "ts": 2,
+         "result": {"evals_per_sec": 100.0, "truncated": 0}},
+        {"ok": True, "stage": "vmbatch_pop64", "ts": 3,
+         "result": {"code_evals_per_sec": 50.0}},
+        {"ok": False, "stage": "fused64", "ts": 4,
+         "result": {"evals_per_sec": 12345.0}},  # failed probe: ignored
+    ])
+    best, code_best = bench._banked_measurement()
+    assert best["value"] == 100.0 and best["file"] == "round6_tpu.jsonl"
+    assert code_best["value"] == 50.0
+    assert code_best["file"] == "round6_tpu.jsonl"
+
+
+def test_banked_measurement_empty_results(banked_repo):
+    assert bench._banked_measurement() == (None, None)
+
+
+def test_fallback_json_keeps_headline_zero(banked_repo):
+    """A failed probe reports value/vs_baseline 0.0; the current round's
+    session measurement rides along under banked_from only."""
+    _write_round(banked_repo, 6, [
+        {"ok": True, "stage": "flatseed", "ts": 2,
+         "result": {"evals_per_sec": 321.0}},
+        {"ok": True, "stage": "codetput", "ts": 3,
+         "result": {"code_evals_per_sec": 7.5}},
+    ])
+    payload = json.loads(bench._fallback_json("tunnel wedged"))
+    assert payload["value"] == 0.0 and payload["vs_baseline"] == 0.0
+    assert payload["error"] == "tunnel wedged"
+    assert payload["banked_from"]["value"] == 321.0
+    assert payload["code_banked_from"]["value"] == 7.5
+    assert "banked_from only" in payload["note"]
+
+
+def test_fallback_json_without_any_bank(banked_repo):
+    payload = json.loads(bench._fallback_json("no device"))
+    assert payload["value"] == 0.0
+    assert "banked_from" not in payload
+    assert "no recorded" in payload["note"]

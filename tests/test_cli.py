@@ -120,6 +120,18 @@ def test_scale_runs_sharded_over_virtual_mesh(tmp_path, capsys):
     assert rows[-1]["pods"] == 80
 
 
+def test_scale_code_pop_reports_code_tier(capsys):
+    rc = cli.main(["scale", "--nodes-count", "8", "--pods-count", "16",
+                   "--pop", "2", "--seed", "1", "--engine", "flat",
+                   "--code-pop", "2"])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["mode"] == "sharded over 8 devices"
+    assert out["code_population"] == 2
+    assert out["code_evals_per_sec"] > 0
+    assert out["code_engine"] == "flat"
+
+
 def test_simulate_metrics_schema(micro_cli, tmp_path, capsys):
     metrics = tmp_path / "sim.jsonl"
     rc = cli.main(["simulate", "--policy", "best_fit",

@@ -32,6 +32,11 @@ import numpy as np
 pid, port = int(sys.argv[1]), sys.argv[2]
 
 import jax
+try:  # jax 0.4.x CPU backend has no cross-process collectives built in;
+    # the gloo implementation must be selected before backend init
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+except AttributeError:
+    pass  # newer jax: gloo is the default for multiprocess CPU
 from fks_tpu.parallel.mesh import (
     hybrid_population_mesh, init_distributed, make_sharded_eval,
     pad_population)
@@ -96,9 +101,13 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-@pytest.mark.slow
-def test_two_process_hybrid_mesh(tmp_path):
-    port = _free_port()
+def _run_cluster(tmp_path, port):
+    """Spawn the 2-process cluster on ``port``; (outs, bind_conflict).
+
+    bind_conflict is True when a child died because the coordinator port
+    was taken — _free_port closes the probe socket before the child binds
+    it (TOCTOU), so another process on the host can grab it in between.
+    """
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
@@ -122,8 +131,24 @@ def test_two_process_hybrid_mesh(tmp_path):
             for q in procs:
                 q.kill()
             pytest.fail(f"process {i} timed out forming/running the cluster")
+        if p.returncode != 0 and "already in use" in err.lower():
+            for q in procs:
+                q.kill()
+            return None, True
         assert p.returncode == 0, f"process {i} failed:\n{err[-4000:]}"
         outs.append(out)
+    return outs, False
+
+
+@pytest.mark.slow
+def test_two_process_hybrid_mesh(tmp_path):
+    outs = None
+    for _ in range(3):  # fresh port per attempt; see _run_cluster docstring
+        outs, bind_conflict = _run_cluster(tmp_path, _free_port())
+        if not bind_conflict:
+            break
+    else:
+        pytest.fail("coordinator port stolen on 3 consecutive attempts")
 
     results = []
     for i, out in enumerate(outs):
