@@ -41,7 +41,13 @@ fused first and falls back to flat on any failure),
 FKS_BENCH_DEADLINE_S (controller budget for ALL stages, default 1050 —
 round 2's default of 2400 exceeded the driver's outer budget, so the
 controller was SIGTERMed before its own deadline logic could emit the
-fallback line; see also the signal write-ahead below).
+fallback line; see also the signal write-ahead below),
+FKS_RUN_DIR (flight-record the run: the controller writes stage results
+as ``kind="bench_stage"`` metrics plus the headline into a fks_tpu.obs
+run directory, renderable with ``python -m fks_tpu.cli report DIR``;
+stage records carry ``compile_seconds`` — true XLA backend-compile time
+from the jax.monitoring listener — separately from
+``first_call_seconds``/``steady_state_seconds``).
 3. CODE THROUGHPUT (device subprocess, best-effort): a generation of
    FakeLLM candidates lowered to VM register programs and run as one
    segmented batched launch — reported as ``code_evals_per_sec`` in the
@@ -205,8 +211,40 @@ def _print_result(line: str) -> None:
             signal.pthread_sigmask(signal.SIG_SETMASK, old)
 
 
+_RECORDER = None
+
+
+def _controller_recorder():
+    """Best-effort flight recorder for the controller when FKS_RUN_DIR is
+    set. Lazy and fully guarded: importing fks_tpu pulls jax (package
+    init), which the controller otherwise never does — and a broken
+    recorder must never cost the single-JSON-line contract."""
+    run_dir = os.environ.get("FKS_RUN_DIR", "")
+    if not run_dir:
+        return None
+    try:
+        from fks_tpu.obs.recorder import FlightRecorder
+        return FlightRecorder(run_dir, meta={"command": "bench.py",
+                                             "argv": sys.argv[1:]})
+    except Exception as e:  # noqa: BLE001 — contract over telemetry
+        log(f"FKS_RUN_DIR flight recorder disabled: {e}")
+        return None
+
+
+def _record(method: str, *a, **kw) -> None:
+    """Guarded call on the controller recorder (no-op when absent)."""
+    if _RECORDER is not None:
+        try:
+            getattr(_RECORDER, method)(*a, **kw)
+        except Exception:  # noqa: BLE001 — contract over telemetry
+            pass
+
+
 def _fail(error: str) -> int:
     _print_result(_fallback_json(error))
+    _record("annotate_meta", error=error)
+    _record("finish", "error")
+    _record("close")
     return 1
 
 
@@ -308,15 +346,21 @@ def stage_parity(engine: str) -> int:
 
 def stage_throughput(pop: int, chunk: int, reps: int, engine: str) -> int:
     """Device subprocess: chunked population throughput. Prints one JSON
-    line {"evals_per_sec": ...} on success."""
+    line {"evals_per_sec": ..., "compile_seconds": ..., ...} on success —
+    ``compile_seconds`` is the TRUE XLA backend-compile time observed by
+    the jax.monitoring listener (fks_tpu.obs.CompileWatcher), distinct
+    from ``first_call_seconds`` (cold call: trace + lower + compile + run)
+    and ``steady_state_seconds`` (best timed rep, compile excluded)."""
     import jax
     import numpy as np
 
     from fks_tpu.data import TraceParser
     from fks_tpu.models import parametric
+    from fks_tpu.obs import CompileWatcher
     from fks_tpu.parallel import make_population_eval
     from fks_tpu.sim.engine import SimConfig
 
+    watcher = CompileWatcher().install()
     dev = jax.devices()[0]
     log(f"device: {dev.platform} ({dev.device_kind}); "
         f"pop={pop} chunk={chunk} reps={reps} engine={engine}")
@@ -389,8 +433,16 @@ def stage_throughput(pop: int, chunk: int, reps: int, engine: str) -> int:
         times.append(time.perf_counter() - t0)
     best = min(times)
     log(f"steady-state: {best:.3f}s / {pop} evals "
-        f"({[round(t, 3) for t in times]})")
-    print(json.dumps({"evals_per_sec": pop / best}))
+        f"({[round(t, 3) for t in times]}); XLA backend compile "
+        f"{watcher.backend_compile_seconds:.1f}s "
+        f"({watcher.backend_compile_count} programs)")
+    print(json.dumps({
+        "evals_per_sec": pop / best,
+        "compile_seconds": round(watcher.backend_compile_seconds, 3),
+        "backend_compiles": watcher.backend_compile_count,
+        "first_call_seconds": round(t_compile, 3),
+        "steady_state_seconds": round(best, 3),
+    }))
     return 0
 
 
@@ -409,12 +461,14 @@ def stage_codetput() -> int:
 
     from fks_tpu.data import TraceParser
     from fks_tpu.funsearch import vm
+    from fks_tpu.obs import CompileWatcher
     from fks_tpu.parallel import (
         make_sharded_code_eval, pad_population, population_mesh,
     )
     from fks_tpu.sim import flat
     from fks_tpu.sim.engine import SimConfig
 
+    watcher = CompileWatcher().install()
     pop = int(os.environ.get("FKS_BENCH_CODE_POP", "32"))
     cap = 256
     wl = TraceParser().parse_workload()
@@ -451,7 +505,8 @@ def stage_codetput() -> int:
     t0 = time.perf_counter()
     res = run(vm.stack_programs(progs[:pop], capacity=cap))
     jax.block_until_ready(res.policy_score)
-    log(f"first launch (compile+run): {time.perf_counter() - t0:.1f}s")
+    first_call = time.perf_counter() - t0
+    log(f"first launch (compile+run): {first_call:.1f}s")
     batch = vm.stack_programs(progs[pop:2 * pop], capacity=cap)
     t0 = time.perf_counter()
     res = run(batch)
@@ -459,8 +514,15 @@ def stage_codetput() -> int:
     best = time.perf_counter() - t0
     n_trunc = int(np.asarray(res.truncated)[:pop].sum())
     log(f"steady-state: {best:.3f}s / {pop} code evals "
-        f"(truncated {n_trunc}/{pop})")
-    print(json.dumps({"code_evals_per_sec": pop / best, "mode": mode}))
+        f"(truncated {n_trunc}/{pop}); XLA backend compile "
+        f"{watcher.backend_compile_seconds:.1f}s")
+    print(json.dumps({
+        "code_evals_per_sec": pop / best, "mode": mode,
+        "compile_seconds": round(watcher.backend_compile_seconds, 3),
+        "backend_compiles": watcher.backend_compile_count,
+        "first_call_seconds": round(first_call, 3),
+        "steady_state_seconds": round(best, 3),
+    }))
     return 0
 
 
@@ -519,6 +581,8 @@ def main():
     # controller (hard deadline so the driver always gets the JSON line;
     # every stage/probe timeout below is clamped to the remaining budget)
     _install_kill_writeahead()
+    global _RECORDER
+    _RECORDER = _controller_recorder()
     deadline = time.monotonic() + int(
         os.environ.get("FKS_BENCH_DEADLINE_S", "1050"))
     budget = lambda: int(deadline - time.monotonic())  # noqa: E731
@@ -585,15 +649,21 @@ def main():
             log(f"backend probe: {err}")
             return _fail(err)
 
-    evals_per_sec = None
+    stage_res = None
     for line in reversed(out.strip().splitlines()):
         try:
-            evals_per_sec = json.loads(line)["evals_per_sec"]
-            break
-        except (json.JSONDecodeError, KeyError, TypeError):
+            cand = json.loads(line)
+            if isinstance(cand, dict) and "evals_per_sec" in cand:
+                stage_res = cand
+                break
+        except json.JSONDecodeError:
             continue
-    if evals_per_sec is None:
+    if stage_res is None:
         return _fail("throughput stage produced no parsable result")
+    evals_per_sec = stage_res["evals_per_sec"]
+    _record("metric", "bench_stage", stage_res, stage="throughput",
+            engine=engines[eng_i], population=pop, chunk=chunk,
+            platform=platform)
 
     # code-candidate throughput, best-effort (never fails the bench):
     # live measurement when the budget allows, else the freshest session
@@ -605,10 +675,14 @@ def main():
         if out2 is not None:
             for line in reversed(out2.strip().splitlines()):
                 try:
-                    code_eps = json.loads(line)["code_evals_per_sec"]
-                    code_src = "live"
-                    break
-                except (json.JSONDecodeError, KeyError, TypeError):
+                    cand = json.loads(line)
+                    if isinstance(cand, dict) and "code_evals_per_sec" in cand:
+                        code_eps = cand["code_evals_per_sec"]
+                        code_src = "live"
+                        _record("metric", "bench_stage", cand,
+                                stage="codetput", platform=platform)
+                        break
+                except json.JSONDecodeError:
                     continue
     if code_eps is None:
         _, code_banked = _banked_measurement()
@@ -622,12 +696,23 @@ def main():
         "unit": "evals/s",
         "vs_baseline": round(evals_per_sec / BASELINE_EVALS_PER_SEC, 3),
     }
+    # compile-vs-steady-state split from the winning throughput stage
+    # (PAPERS.md: evosax/Fast PBRL report the two separately; so do we)
+    for k in ("compile_seconds", "backend_compiles", "first_call_seconds",
+              "steady_state_seconds"):
+        if k in stage_res:
+            payload[k] = stage_res[k]
     if code_eps is not None:
         payload["code_evals_per_sec"] = round(code_eps, 2)
         payload["code_vs_reference_40eps"] = round(
             code_eps / BASELINE_EVALS_PER_SEC, 3)
         if code_src != "live":
             payload["code_source"] = code_src
+    _record("metric", "headline", payload)
+    _record("annotate_meta", value=payload["value"],
+            vs_baseline=payload["vs_baseline"])
+    _record("finish", "ok")
+    _record("close")
     _print_result(json.dumps(payload))
     return 0
 

@@ -13,7 +13,12 @@ Usage:
     python -m fks_tpu.cli simulate --policy best_fit [--validate]
     python -m fks_tpu.cli evolve [--config F] [--fake-llm] [--checkpoint F]
     python -m fks_tpu.cli scale [--nodes-count N] [--pods-count P] [--pop C]
+    python -m fks_tpu.cli report RUN_DIR
     python -m fks_tpu.cli traces
+
+Every subcommand accepts ``--run-dir DIR`` to flight-record the run
+(fks_tpu.obs): spans, compile/device telemetry, and per-generation
+evolution ledger land in DIR as JSONL; ``report DIR`` renders the summary.
 """
 from __future__ import annotations
 
@@ -21,7 +26,6 @@ import argparse
 import contextlib
 import json
 import sys
-import time
 
 
 def _apply_platform_flags(args):
@@ -89,6 +93,20 @@ def _metrics_writer(args):
     return contextlib.nullcontext(None)
 
 
+def _flight_recorder(args, command):
+    """Context manager installing the process-wide flight recorder when
+    ``--run-dir`` was given (fks_tpu.obs.recording), else the shared
+    NullRecorder — identical API, zero filesystem writes. Opened up front
+    so an unwritable run directory fails before any device work."""
+    from fks_tpu import obs
+
+    run_dir = getattr(args, "run_dir", "")
+    if not run_dir:
+        return obs.recording(obs.NULL)
+    return obs.recording(obs.FlightRecorder(
+        run_dir, meta={"command": command, "argv": sys.argv[1:]}))
+
+
 def _parse_workload(args):
     from fks_tpu.data import TraceParser
 
@@ -150,6 +168,8 @@ def cmd_bench(args):
     from fks_tpu.sim.engine import SimConfig
     from fks_tpu.utils import result_record
 
+    from fks_tpu import obs
+
     simulate = _pick_simulate(args)
     _, wl = _parse_workload(args)
     names = (args.policies.split(",") if args.policies else list(zoo.ZOO))
@@ -158,20 +178,28 @@ def cmd_bench(args):
     print(f"workload: {wl.num_nodes} nodes x {wl.num_pods} pods "
           f"({args.nodes} x {args.trace})", file=sys.stderr)
     rows = []
-    with _metrics_writer(args) as metrics:
+    with _flight_recorder(args, "bench") as rec, \
+            obs.watch_compiles(rec), _metrics_writer(args) as metrics:
+        if rec.enabled:
+            rec.annotate_meta(engine=args.engine, trace=args.trace,
+                              workload={"nodes": wl.num_nodes,
+                                        "pods": wl.num_pods})
+            obs.record_devices(rec)
         for name in names:
             if name not in zoo.ZOO:
                 print(f"unknown policy {name!r}; have {list(zoo.ZOO)}",
                       file=sys.stderr)
                 return 2
-            t0 = time.time()
-            res = simulate(wl, zoo.ZOO[name](dtype=dtype), cfg)
-            res.policy_score.block_until_ready()
-            wall = time.time() - t0
+            with obs.span("policy", policy=name) as t:
+                res = simulate(wl, zoo.ZOO[name](dtype=dtype), cfg)
+                t.sync(res.policy_score)
+            wall = t.seconds
             rows.append(_result_row(name, res, wall))
             if metrics:
                 metrics.write("bench", result_record(res), policy=name,
                               wall_s=wall, trace=args.trace, nodes=args.nodes)
+            rec.metric("bench", result_record(res), policy=name,
+                       wall_s=wall, trace=args.trace, nodes=args.nodes)
             if args.validate and int(res.invariant_violations):
                 print(f"WARNING: {name}: {int(res.invariant_violations)} "
                       "invariant violations", file=sys.stderr)
@@ -190,15 +218,19 @@ def cmd_simulate(args):
     from fks_tpu.sim.engine import SimConfig
     from fks_tpu.utils import result_record
 
+    from fks_tpu import obs
+
     simulate = _pick_simulate(args)
     _, wl = _parse_workload(args)
     dtype = jnp.float64 if args.f64 else jnp.float32
     cfg = SimConfig(score_dtype=dtype, validate_invariants=args.validate)
-    with _metrics_writer(args) as metrics:  # up front: bad paths fail fast
-        t0 = time.time()
-        res = simulate(wl, zoo.ZOO[args.policy](dtype=dtype), cfg)
-        res.policy_score.block_until_ready()
-        wall = time.time() - t0
+    with _flight_recorder(args, "simulate") as rec, \
+            obs.watch_compiles(rec), \
+            _metrics_writer(args) as metrics:  # up front: bad paths fail fast
+        with obs.span("simulate", policy=args.policy) as t:
+            res = simulate(wl, zoo.ZOO[args.policy](dtype=dtype), cfg)
+            t.sync(res.policy_score)
+        wall = t.seconds
         n_pods = wl.num_pods
         gpu_pods = int(np.sum(np.asarray(wl.pods.num_gpu)[:n_pods] > 0))
         out = _result_row(args.policy, res, wall)
@@ -211,6 +243,8 @@ def cmd_simulate(args):
         if metrics:
             metrics.write("simulate", result_record(res), policy=args.policy,
                           wall_s=wall, trace=args.trace, nodes=args.nodes)
+        rec.metric("simulate", result_record(res), policy=args.policy,
+                   wall_s=wall, trace=args.trace, nodes=args.nodes)
     print(json.dumps(out, indent=2))
     return 0
 
@@ -289,8 +323,17 @@ def cmd_evolve(args):
                   f"{args.trace} for a measured flat-vs-exact bound",
                   file=sys.stderr)
     _apply_platform_flags(args)
+    from fks_tpu import obs
+
     _, wl = _parse_workload(args)
-    with _metrics_writer(args) as metrics:
+    with _flight_recorder(args, "evolve") as rec, \
+            obs.watch_compiles(rec), _metrics_writer(args) as metrics:
+        if rec.enabled:
+            rec.annotate_meta(engine=args.engine, trace=args.trace,
+                              nodes=args.nodes,
+                              workload={"nodes": wl.num_nodes,
+                                        "pods": wl.num_pods})
+            obs.record_devices(rec)
         on_gen = None
         if metrics:
             import dataclasses
@@ -302,6 +345,10 @@ def cmd_evolve(args):
         fs = evo.run(wl, cfg, backend=backend, sim_config=SimConfig(),
                      checkpoint_path=args.checkpoint, out_dir=args.out,
                      engine=args.engine, on_generation=on_gen)
+        if fs.best:
+            rec.annotate_meta(best_score=fs.best[1],
+                              best_exact=fs.best_exact,
+                              generations=fs.generation)
     if fs.best:
         print(f"best fitness: {fs.best[1]:.4f}")
         # on interrupt evo.run already persisted champions — don't double-save
@@ -322,20 +369,30 @@ def cmd_scale(args):
     _apply_platform_flags(args)
     import jax
 
+    from fks_tpu import obs
     from fks_tpu.data.synthetic import synthetic_workload
     from fks_tpu.models import parametric
+    from fks_tpu.obs import span
     from fks_tpu.parallel import (
         make_population_eval, make_sharded_eval, pad_population,
         population_mesh,
     )
     from fks_tpu.sim.engine import SimConfig
-    from fks_tpu.utils import ThroughputMeter, timed
+    from fks_tpu.utils import ThroughputMeter
 
-    with _metrics_writer(args) as metrics:  # up front: bad paths fail fast
+    with _flight_recorder(args, "scale") as rec, \
+            obs.watch_compiles(rec), \
+            _metrics_writer(args) as metrics:  # up front: bad paths fail fast
         wl = synthetic_workload(args.nodes_count, args.pods_count,
                                 seed=args.seed)
         print(f"synthetic workload: {wl.num_nodes} nodes x {wl.num_pods} "
               f"pods, population {args.pop}", file=sys.stderr)
+        if rec.enabled:
+            rec.annotate_meta(engine=args.engine,
+                              workload={"nodes": wl.num_nodes,
+                                        "pods": wl.num_pods},
+                              population=args.pop)
+            obs.record_devices(rec)
         pop = parametric.init_population(
             jax.random.PRNGKey(args.seed), args.pop, noise=0.1)
         cfg = SimConfig()
@@ -344,15 +401,16 @@ def cmd_scale(args):
             if len(devices) > 1:
                 mesh = population_mesh(devices)
                 padded, real = pad_population(pop, mesh)
+                obs.record_mesh(mesh, real_count=args.pop, recorder=rec)
                 ev = make_sharded_eval(wl, mesh, cfg=cfg,
                                        elite_k=min(4, args.pop),
                                        engine=args.engine)
-                with timed("eval") as t:
+                with span("eval", population=args.pop) as t:
                     scores = t.sync(ev(padded, real)[0])[:real]
                 mode = f"sharded over {len(devices)} devices"
             else:
                 evp = make_population_eval(wl, cfg=cfg, engine=args.engine)
-                with timed("eval") as t:
+                with span("eval", population=args.pop) as t:
                     res = t.sync(evp(pop))
                 scores = res.policy_score
                 mode = "vmap on 1 device"
@@ -393,12 +451,12 @@ def cmd_scale(args):
                 cev = make_sharded_code_eval(
                     wl, mesh, cfg=cfg, elite_k=min(4, args.code_pop),
                     engine=code_engine)
-                with timed("code eval") as ct:
+                with span("code_eval", code_population=args.code_pop) as ct:
                     cres = ct.sync(cev(cpadded, creal)[0])
             else:
                 mod = get_engine(code_engine)
                 crun = mod.make_population_run_fn(wl, vm.score_static, cfg)
-                with timed("code eval") as ct:
+                with span("code_eval", code_population=args.code_pop) as ct:
                     cres = ct.sync(crun(stacked, mod.initial_state(wl, cfg)))
             cscores = cres.policy_score[: args.code_pop]
             cmeter = ThroughputMeter()
@@ -412,7 +470,23 @@ def cmd_scale(args):
             })
         if metrics:
             metrics.write("scale", out)
+        rec.metric("scale", out)
     print(json.dumps(out, indent=2))
+    return 0
+
+
+def cmd_report(args):
+    """Render a flight-recorder run directory (written by ``--run-dir``)
+    back into a human-readable summary — generations table with a fitness
+    sparkline, admit/reject breakdown, compile events, span hotspots — from
+    the JSONL files alone (no in-process state)."""
+    from fks_tpu.obs import render_report
+
+    try:
+        print(render_report(args.run_dir))
+    except FileNotFoundError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
     return 0
 
 
@@ -438,6 +512,10 @@ def main(argv=None) -> int:
                         help="force the CPU backend (skip the TPU tunnel)")
     common.add_argument("--metrics", default="",
                         help="append JSONL metric records to this file")
+    common.add_argument("--run-dir", default="",
+                        help="flight-recorder run directory (meta.json, "
+                             "events.jsonl, metrics.jsonl, heartbeat); "
+                             "render afterwards with 'fks_tpu report DIR'")
     common.add_argument("--engine", choices=("exact", "flat", "fused"),
                         default="exact",
                         help="simulation engine: 'exact' replicates the "
@@ -495,6 +573,11 @@ def main(argv=None) -> int:
                          "single-device vmap; this replaces setting "
                          "XLA_FLAGS=--xla_force_host_platform_device_count)")
     sc.set_defaults(fn=cmd_scale)
+
+    r = sub.add_parser("report",
+                       help="summarize a flight-recorder run directory")
+    r.add_argument("run_dir", help="directory written by --run-dir")
+    r.set_defaults(fn=cmd_report)
 
     t = sub.add_parser("traces", help="list available trace files")
     t.set_defaults(fn=cmd_traces)

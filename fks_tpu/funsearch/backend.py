@@ -88,6 +88,10 @@ class CodeEvaluator:
         self._lock = threading.Lock()
         self.compile_count = 0  # observability: unique programs built
         self.vm_count = 0  # candidates served by the VM tier (no compile)
+        # observability: host-loop segment dispatches from the segmented
+        # batched runners (fks_tpu.obs ledger reads per-generation deltas)
+        self.segments_dispatched = 0
+        self.last_eval_stats: Dict[str, int] = {}  # most recent evaluate()
         self.max_workers = max_workers or min(8, os.cpu_count() or 1)
         self.use_vm = use_vm
         self._vm_run = None  # lazily built shared engine program
@@ -162,6 +166,12 @@ class CodeEvaluator:
 
     # ----- batched VM tier: a GENERATION as one device program
 
+    def _count_segment(self):
+        """Host-loop segment-dispatch callback from the segmented batched
+        runners (runs between device calls, never inside them)."""
+        with self._lock:
+            self.segments_dispatched += 1
+
     def _vm_pop_runner(self):
         if self._vm_pop_run is None:
             # population semantics per SimConfig.cond_policy docs: under
@@ -173,7 +183,8 @@ class CodeEvaluator:
                 # unsegmented runner (tests/test_flat_engine.py)
                 self._vm_pop_run = self._mod.make_segmented_population_run(
                     self.workload, vm.score_static, self.cfg,
-                    seg_steps=self.vm_seg_steps)
+                    seg_steps=self.vm_seg_steps,
+                    on_segment=self._count_segment)
             else:
                 self._vm_pop_run = jax.jit(
                     self._mod.make_population_run_fn(
@@ -185,7 +196,8 @@ class CodeEvaluator:
             from fks_tpu.parallel.mesh import make_sharded_code_eval
             self._vm_mesh_run = make_sharded_code_eval(
                 self.workload, self.mesh, cfg=self.cfg, elite_k=1,
-                engine=self.engine, seg_steps=self.vm_seg_steps)
+                engine=self.engine, seg_steps=self.vm_seg_steps,
+                on_segment=self._count_segment)
         return self._vm_mesh_run
 
     def _run_vm_batch(self, progs: List[vm.VMProgram]) -> List[SimResult]:
@@ -199,19 +211,25 @@ class CodeEvaluator:
         Replaces the reference's one-subprocess-per-candidate fan-out
         (funsearch_integration.py:535-562) with one XLA program.
         """
+        from fks_tpu.obs import span
+
         pop = vm.bucket_lanes(len(progs), self._n_shards)
         padded = list(progs) + [progs[-1]] * (pop - len(progs))
         stacked = vm.stack_programs(padded)
-        if self._n_shards > 1:
-            # each device interprets pop/shards lanes; the elite outputs
-            # are discarded here (the evolution loop ranks on the host,
-            # where admission/dedup live)
-            result, _, _ = self._vm_mesh_runner()(stacked, len(progs))
-        else:
-            result = self._vm_pop_runner()(stacked, self.state0)
-        # ONE device->host transfer for the whole generation: slicing lazy
-        # device arrays would cost ~3 tiny syncs per lane in _record
-        result = jax.device_get(result)
+        # the span's clock covers the device work AND the one transfer:
+        # device_get materializes the whole generation, so no extra sync
+        with span("vm_batch", candidates=len(progs), lanes=pop,
+                  shards=self._n_shards):
+            if self._n_shards > 1:
+                # each device interprets pop/shards lanes; the elite
+                # outputs are discarded here (the evolution loop ranks on
+                # the host, where admission/dedup live)
+                result, _, _ = self._vm_mesh_runner()(stacked, len(progs))
+            else:
+                result = self._vm_pop_runner()(stacked, self.state0)
+            # ONE device->host transfer for the whole generation: slicing
+            # lazy device arrays would cost ~3 tiny syncs/lane in _record
+            result = jax.device_get(result)
         with self._lock:
             self.vm_batch_count += 1
             self.vm_count += len(progs)
@@ -276,6 +294,7 @@ class CodeEvaluator:
         population admission order — matches the input order regardless of
         completion order.
         """
+        seg0 = self.segments_dispatched
         keyed: List[Optional[str]] = []
         errors: Dict[int, EvalRecord] = {}
         for i, code in enumerate(codes):
@@ -315,12 +334,14 @@ class CodeEvaluator:
         else:
             general = dict(unique)
 
+        batch_served = 0
         if vm_progs:
             vm_keys = list(vm_progs)
             try:
                 results = self._run_vm_batch([vm_progs[k] for k in vm_keys])
                 for key, res in zip(vm_keys, results):
                     memo[key] = self._record(unique[key], res)
+                batch_served = len(vm_keys)
             except Exception as e:  # noqa: BLE001 — batch failed:
                 # per-candidate fallback still produces scores, but say
                 # WHY the one-launch-per-generation path is not engaging
@@ -340,6 +361,17 @@ class CodeEvaluator:
                              for key, code in general.items()})
                 for key, f in futs.items():
                     memo[key] = f.result()
+
+        # observability: how this batch was served, for the evolution
+        # ledger / flight recorder (host bookkeeping only — no device work)
+        self.last_eval_stats = {
+            "candidates": len(codes),
+            "unique": len(unique),
+            "syntax_failed": len(errors),
+            "vm_batch_lanes": batch_served,
+            "fallback_lanes": len(jit_only) + len(general),
+            "segments": self.segments_dispatched - seg0,
+        }
 
         out = []
         for i, (key, code) in enumerate(zip(keyed, codes)):

@@ -29,9 +29,9 @@ from typing import Callable, List, Optional, Sequence, Tuple
 
 import jax
 
+from fks_tpu import obs
 from fks_tpu.funsearch import llm as llm_mod
 from fks_tpu.funsearch import template
-from fks_tpu.utils import profiling
 from fks_tpu.funsearch.backend import CodeEvaluator
 from fks_tpu.sim.engine import SimConfig
 
@@ -117,9 +117,49 @@ class GenerationStats:
     mean_score: float
     new_candidates: int
     accepted: int
-    rejected_similar: int
+    rejected_similar: int  # dup-suppressed (difflib near-duplicate)
     eval_seconds: float
     compile_count: int
+    # fitness distribution over the post-truncation population (best /
+    # median / p10 is the trio population-based stacks track per
+    # generation; PAPERS.md: evosax, Fast PBRL)
+    median_score: float = 0.0
+    p10_score: float = 0.0
+    # reject/failure breakdown the loop already observes (EvalRecord
+    # errors + exact-rescore fallbacks) — previously dropped on the floor
+    sandbox_failed: int = 0  # candidate raised during sandboxed execution
+    transpile_failed: int = 0  # syntax / transpile rejection
+    rescore_fallbacks: int = 0  # exact rescore failed -> search fitness
+    llm_seconds: float = 0.0  # wall time of the LLM candidate stage
+
+
+def _percentile(sorted_desc: Sequence[float], q: float) -> float:
+    """Nearest-rank quantile (q in [0, 1], from the BOTTOM) of an already
+    descending-sorted score list; 0.0 on empty."""
+    if not sorted_desc:
+        return 0.0
+    idx = min(len(sorted_desc) - 1,
+              max(0, int(round((1.0 - q) * (len(sorted_desc) - 1)))))
+    return float(sorted_desc[idx])
+
+
+def _failure_counts(records) -> Tuple[int, int]:
+    """(sandbox_failed, transpile_failed) breakdown of a generation's
+    EvalRecords. Transpile-fail covers the static rejections ("syntax:",
+    "transpile:"); sandbox-fail covers everything that failed while
+    actually running — candidate exceptions ("runtime:") and simulated
+    aborts (gpu allocation aborted / event budget exceeded). Failed
+    candidates still enter selection at score 0 (reference semantics);
+    these counters are observational only."""
+    sandbox = transpile = 0
+    for r in records:
+        if r.error is None:
+            continue
+        if r.error.startswith(("syntax", "transpile")):
+            transpile += 1
+        else:
+            sandbox += 1
+    return sandbox, transpile
 
 
 # ------------------------------------------------------------------ driver
@@ -133,11 +173,18 @@ class FunSearch:
                  backend: Optional[llm_mod.TextBackend] = None,
                  log: Callable[[str], None] = print,
                  on_generation: Optional[
-                     Callable[["GenerationStats"], None]] = None):
+                     Callable[["GenerationStats"], None]] = None,
+                 recorder: Optional[obs.NullRecorder] = None):
         self.cfg = config
         self.evaluator = evaluator
         self.rng = random.Random(config.seed)
         self.log = log
+        # flight recorder: explicit > process-wide active (cli --run-dir
+        # installs one via obs.recording); defaults to the NullRecorder,
+        # under which the ledger performs zero filesystem writes
+        self.recorder = recorder if recorder is not None else obs.get_recorder()
+        self.ledger = obs.EvolutionLedger(self.recorder, evaluator)
+        self.rescore_fallbacks = 0  # lifetime count; per-gen delta in stats
         if backend is None:
             if config.llm.api_key:
                 backend = llm_mod.OpenAIBackend(
@@ -265,6 +312,7 @@ class FunSearch:
             # pressure away from the best member for the rest of the run.
             # NOT memoized: the failure is transient; the next _sort
             # retries the exact rescore.
+            self.rescore_fallbacks += 1
             self.log(f"  exact rescore failed ({type(e).__name__}: {e}); "
                      f"falling back to search fitness {score:.4f}")
             return score
@@ -305,6 +353,8 @@ class FunSearch:
     def evolve_generation(self) -> GenerationStats:
         self.generation += 1
         cfg = self.cfg
+        self.ledger.begin_generation()
+        fallbacks0 = self.rescore_fallbacks
         self._sort()
         n_new = min(cfg.candidates_per_generation,
                     max(0, cfg.population_size - cfg.elite_size))
@@ -312,16 +362,21 @@ class FunSearch:
         if self.best:
             feedback = (f"best fitness so far {self.best[1]:.4f}; "
                         "higher utilization with less GPU fragmentation wins")
-        codes = llm_mod.generate_many(
-            self.generator, n_new, self._sample_parents, feedback,
-            cfg.max_workers)
+        with obs.span("llm", generation=self.generation,
+                      candidates=n_new) as lt:
+            codes = llm_mod.generate_many(
+                self.generator, n_new, self._sample_parents, feedback,
+                cfg.max_workers)
+        llm_s = lt.seconds
 
         # plain wall time: evaluate() returns host floats (each candidate's
         # score is materialized inside), so there is nothing left to sync —
         # and its EvalRecord dataclasses are opaque to block_until_ready
-        with profiling.timed("evaluate") as t:
+        with obs.span("evaluate", generation=self.generation,
+                      candidates=len(codes)) as t:
             records = self.evaluator.evaluate(codes)
         eval_s = t.seconds
+        sandbox_failed, transpile_failed = _failure_counts(records)
 
         accepted = rejected = 0
         for r in records:
@@ -344,15 +399,24 @@ class FunSearch:
         self._sort()
         del self.population[cfg.population_size:]
 
-        scores = [s for _, s in self.population]
+        scores = [s for _, s in self.population]  # descending post-_sort
         stats = GenerationStats(
             generation=self.generation,
             best_score=self.best[1] if self.best else 0.0,
             mean_score=sum(scores) / len(scores) if scores else 0.0,
             new_candidates=len(codes), accepted=accepted,
             rejected_similar=rejected, eval_seconds=eval_s,
-            compile_count=self.evaluator.compile_count)
+            compile_count=self.evaluator.compile_count,
+            median_score=_percentile(scores, 0.5),
+            p10_score=_percentile(scores, 0.10),
+            sandbox_failed=sandbox_failed,
+            transpile_failed=transpile_failed,
+            rescore_fallbacks=self.rescore_fallbacks - fallbacks0,
+            llm_seconds=llm_s)
         self.history.append(stats)
+        # ledger first: the flight-recorder trail must be complete even if a
+        # user on_generation callback raises
+        self.ledger.commit(stats)
         if self.on_generation is not None:
             # streamed per generation so an interrupted run still leaves a
             # complete metric trail (fks_tpu.utils.logging contract)
@@ -525,6 +589,7 @@ def run(workload, config: Optional[EvolutionConfig] = None,
         engine: str = "exact",
         log: Callable[[str], None] = print,
         on_generation: Optional[Callable[[GenerationStats], None]] = None,
+        recorder: Optional[obs.NullRecorder] = None,
         ) -> FunSearch:
     """Assemble evaluator + driver, optionally resuming from a checkpoint,
     and run to completion. Returns the driver for inspection.
@@ -535,7 +600,7 @@ def run(workload, config: Optional[EvolutionConfig] = None,
     must never lose its discoveries."""
     fs = FunSearch(CodeEvaluator(workload, sim_config, engine=engine),
                    config or EvolutionConfig(), backend, log,
-                   on_generation=on_generation)
+                   on_generation=on_generation, recorder=recorder)
     if checkpoint_path and os.path.exists(checkpoint_path):
         fs.restore(checkpoint_path)
         log(f"resumed from {checkpoint_path} at generation {fs.generation}")

@@ -1,0 +1,35 @@
+"""fks_tpu.obs — the flight recorder: run directories, spans, compile/
+device telemetry, and the per-generation evolution ledger.
+
+Every ROADMAP evidence gap is an observability gap; this package records
+what a run actually did, into a run directory that ``cli report`` renders
+back without any in-process state (fks_tpu.obs.report). The disabled path
+is a shared NullRecorder — zero filesystem writes, no conditionals in
+jitted code.
+
+- ``recorder``  — FlightRecorder/NullRecorder + the process-wide active
+                  recorder (``get_recorder``/``recording``)
+- ``spans``     — nested wall-clock scopes mirrored into xprof
+                  (generalizes ``utils.profiling.timed``)
+- ``telemetry`` — jax.monitoring compile listener, device memory_stats,
+                  mesh/pad-waste snapshots
+- ``ledger``    — per-generation evolution records
+- ``report``    — run-dir summary rendering (``cli report``)
+"""
+from fks_tpu.obs.ledger import EvolutionLedger
+from fks_tpu.obs.recorder import (
+    NULL, FlightRecorder, NullRecorder, get_recorder, recording,
+)
+from fks_tpu.obs.report import render_report, sparkline
+from fks_tpu.obs.spans import span, span_path
+from fks_tpu.obs.telemetry import (
+    CompileWatcher, device_snapshot, mesh_snapshot, record_devices,
+    record_mesh, watch_compiles,
+)
+
+__all__ = [
+    "NULL", "CompileWatcher", "EvolutionLedger", "FlightRecorder",
+    "NullRecorder", "device_snapshot", "get_recorder", "mesh_snapshot",
+    "record_devices", "record_mesh", "recording", "render_report", "span",
+    "span_path", "sparkline", "watch_compiles",
+]

@@ -1,0 +1,208 @@
+"""The flight recorder: one run, one directory, everything the run did.
+
+A ``FlightRecorder`` owns a run directory with a fixed layout:
+
+- ``meta.json``     — run identity: run_id, command/argv, start/finish
+                      timestamps, status, plus anything callers annotate
+                      (rewritten atomically on every annotation);
+- ``events.jsonl``  — append-only operational events (spans, compile
+                      telemetry, device/mesh snapshots), one JSON object
+                      per line with ``ts``/``kind``/``seq``;
+- ``metrics.jsonl`` — append-only metric records (the evolution ledger's
+                      per-generation rows, bench stage results), same
+                      ``ts``/``kind`` framing as ``utils.MetricsWriter``
+                      because it IS a ``MetricsWriter`` underneath;
+- ``heartbeat``     — a tiny JSON file rewritten (atomic replace) on every
+                      ledger commit, so an external watcher can tell a
+                      slow run from a dead one without parsing the JSONL.
+
+``cli report <run-dir>`` renders a run summary from these files alone — no
+in-process state survives the run, by design (fks_tpu.obs.report).
+
+The disabled path is a ``NullRecorder``: identical API, zero filesystem
+writes, no conditionals anywhere in jitted code (all device-side numbers
+recorded through this module come from values the eval paths already
+return, or from host-side jax.monitoring listeners). The process-wide
+active recorder defaults to the shared NullRecorder; ``recording(rec)``
+installs a real one for a scope (the CLI does this for ``--run-dir``).
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import secrets
+import threading
+import time
+from typing import Any, Dict, Iterator, Optional
+
+from fks_tpu.utils.logging import MetricsWriter, json_ready
+
+
+class NullRecorder:
+    """The disabled flight recorder: full API, zero filesystem writes.
+
+    Shared default for every instrumented path, so instrumentation never
+    needs an ``if recorder:`` guard (and the no-run-dir path stays
+    near-zero overhead: each call is one no-op method dispatch).
+    """
+
+    enabled = False
+    run_dir: Optional[str] = None
+    run_id: Optional[str] = None
+
+    def event(self, kind: str, **fields) -> None:
+        pass
+
+    def metric(self, kind: str, record: Optional[Dict[str, Any]] = None,
+               **fields) -> None:
+        pass
+
+    def heartbeat(self) -> None:
+        pass
+
+    def annotate_meta(self, **fields) -> None:
+        pass
+
+    def finish(self, status: str = "ok") -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+    def __enter__(self) -> "NullRecorder":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        pass
+
+
+class FlightRecorder(NullRecorder):
+    """A live run directory (see module docstring for the layout)."""
+
+    enabled = True
+
+    def __init__(self, run_dir: str,
+                 meta: Optional[Dict[str, Any]] = None) -> None:
+        os.makedirs(run_dir, exist_ok=True)
+        self.run_dir = os.fspath(run_dir)
+        # sortable + collision-proof: wall-clock stamp, random suffix
+        self.run_id = (time.strftime("%Y%m%d_%H%M%S") + "-"
+                       + secrets.token_hex(3))
+        self._t0 = time.time()
+        self._meta: Dict[str, Any] = {
+            "run_id": self.run_id,
+            "started": time.strftime("%Y-%m-%dT%H:%M:%S"),
+            "started_ts": self._t0,
+            "status": "running",
+        }
+        if meta:
+            self._meta.update(meta)
+        self._meta_lock = threading.Lock()
+        self._write_meta()
+        self._events = MetricsWriter(os.path.join(run_dir, "events.jsonl"))
+        self._metrics = MetricsWriter(os.path.join(run_dir, "metrics.jsonl"))
+        self._seq = 0
+        self._seq_lock = threading.Lock()
+        self._closed = False
+        self.heartbeat()
+
+    # ----- the three write surfaces
+
+    def event(self, kind: str, **fields) -> None:
+        """Operational event -> ``events.jsonl`` (spans, compiles,
+        device/mesh snapshots). ``seq`` is a process-wide monotonic
+        counter so concurrent writers (compile listeners fire from the
+        evaluator's thread pool) keep a total order even when ``ts``
+        collides at clock resolution."""
+        with self._seq_lock:
+            seq = self._seq
+            self._seq += 1
+        self._events.write(kind, seq=seq, **fields)
+
+    def metric(self, kind: str, record: Optional[Dict[str, Any]] = None,
+               **fields) -> None:
+        """Metric record -> ``metrics.jsonl`` (ledger generations, bench
+        stages); same schema as ``--metrics`` JSONL output."""
+        self._metrics.write(kind, record, **fields)
+
+    def heartbeat(self) -> None:
+        """Atomically rewrite the heartbeat file with the current time —
+        liveness for external watchers, no JSONL parsing required."""
+        path = os.path.join(self.run_dir, "heartbeat")
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"ts": time.time(), "run_id": self.run_id}, f)
+        os.replace(tmp, path)
+
+    # ----- meta lifecycle
+
+    def annotate_meta(self, **fields) -> None:
+        """Merge fields into ``meta.json`` (atomic rewrite) — final best
+        score, workload shape, anything identity-grade rather than
+        event-grade."""
+        with self._meta_lock:
+            self._meta.update(fields)
+            self._write_meta()
+
+    def finish(self, status: str = "ok") -> None:
+        self.annotate_meta(
+            status=status,
+            finished=time.strftime("%Y-%m-%dT%H:%M:%S"),
+            wall_seconds=round(time.time() - self._t0, 3))
+        self.heartbeat()
+
+    def close(self) -> None:
+        if not self._closed:
+            self._closed = True
+            self._events.close()
+            self._metrics.close()
+
+    def _write_meta(self) -> None:
+        path = os.path.join(self.run_dir, "meta.json")
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(self._meta, f, indent=2, default=json_ready)
+        os.replace(tmp, path)
+
+    def __enter__(self) -> "FlightRecorder":
+        return self
+
+    def __exit__(self, exc_type, *exc) -> None:
+        self.finish("ok" if exc_type is None else "error")
+        self.close()
+
+
+# ------------------------------------------------- process-wide recorder
+
+NULL = NullRecorder()
+_active: NullRecorder = NULL
+
+
+def get_recorder() -> NullRecorder:
+    """The process-wide active recorder (the shared NullRecorder unless a
+    ``recording(...)`` scope is open). Instrumented paths default to this,
+    so a CLI ``--run-dir`` reaches spans/ledgers deep in the stack without
+    threading a recorder through every signature."""
+    return _active
+
+
+@contextlib.contextmanager
+def recording(recorder: NullRecorder) -> Iterator[NullRecorder]:
+    """Install ``recorder`` as the process-wide active recorder for the
+    scope; on exit, finish (status from exception state), close, and
+    restore the previous recorder. Null recorders pass through unchanged
+    (finish/close are no-ops)."""
+    global _active
+    prev = _active
+    _active = recorder
+    try:
+        yield recorder
+    except BaseException:
+        _active = prev
+        recorder.finish("error")
+        recorder.close()
+        raise
+    _active = prev
+    recorder.finish("ok")
+    recorder.close()

@@ -1,0 +1,202 @@
+"""Run-dir report: summary table + fitness sparkline from the JSONL alone.
+
+``cli report <run-dir>`` renders what a finished (or still-running, or
+crashed — the JSONL is append-only and flushed per record) run did, with
+no in-process state: meta.json for identity, metrics.jsonl for the
+evolution ledger / bench stages, events.jsonl for spans, compile
+telemetry, and device/mesh snapshots.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+SPARK_BARS = "▁▂▃▄▅▆▇█"
+
+
+def sparkline(values: List[float]) -> str:
+    """Unicode sparkline; constant series render mid-height."""
+    if not values:
+        return ""
+    lo, hi = min(values), max(values)
+    if hi <= lo:
+        return SPARK_BARS[3] * len(values)
+    scale = (len(SPARK_BARS) - 1) / (hi - lo)
+    return "".join(SPARK_BARS[int(round((v - lo) * scale))] for v in values)
+
+
+def read_jsonl(path: str) -> List[Dict[str, Any]]:
+    """Parse a JSONL file line-by-line; raises ValueError naming the line
+    on a corrupt record (a flight recorder flushes whole lines, so a
+    partial trailing line means a crashed writer — tolerated only there)."""
+    rows: List[Dict[str, Any]] = []
+    with open(path) as f:
+        lines = f.read().splitlines()
+    for i, line in enumerate(lines, 1):
+        if not line.strip():
+            continue
+        try:
+            rows.append(json.loads(line))
+        except json.JSONDecodeError:
+            if i == len(lines):  # torn final write from a killed run
+                continue
+            raise ValueError(f"{path}:{i}: unparseable JSONL line") from None
+    return rows
+
+
+def load_run(run_dir: str) -> Tuple[Dict[str, Any], List[Dict[str, Any]],
+                                    List[Dict[str, Any]]]:
+    """(meta, events, metrics) for a run directory; missing JSONL files
+    read as empty (a run may die before its first event), but a missing
+    meta.json means this is not a run directory and raises."""
+    with open(os.path.join(run_dir, "meta.json")) as f:
+        meta = json.load(f)
+    events = metrics = []
+    ep = os.path.join(run_dir, "events.jsonl")
+    mp = os.path.join(run_dir, "metrics.jsonl")
+    if os.path.exists(ep):
+        events = read_jsonl(ep)
+    if os.path.exists(mp):
+        metrics = read_jsonl(mp)
+    return meta, events, metrics
+
+
+def _fmt_table(rows: List[Dict[str, Any]], cols: List[str]) -> List[str]:
+    widths = {c: max(len(c), *(len(str(r.get(c, ""))) for r in rows))
+              for c in cols}
+    head = "  ".join(c.rjust(widths[c]) for c in cols)
+    out = [head, "-" * len(head)]
+    for r in rows:
+        out.append("  ".join(str(r.get(c, "")).rjust(widths[c])
+                             for c in cols))
+    return out
+
+
+def _num(v: Any, nd: int = 4) -> Any:
+    return round(v, nd) if isinstance(v, float) else v
+
+
+def _generation_section(metrics: List[Dict[str, Any]]) -> List[str]:
+    gens = [m for m in metrics if m.get("kind") == "generation"]
+    if not gens:
+        return []
+    rows = [{
+        "gen": g.get("generation"),
+        "best": _num(g.get("best_score", 0.0)),
+        "median": _num(g.get("median_score", 0.0)),
+        "p10": _num(g.get("p10_score", 0.0)),
+        "new": g.get("new_candidates", 0),
+        "acc": g.get("accepted", 0),
+        "dup": g.get("rejected_similar", 0),
+        "sbx": g.get("sandbox_failed", 0),
+        "tpl": g.get("transpile_failed", 0),
+        "rsf": g.get("rescore_fallbacks", 0),
+        "llm_s": _num(g.get("llm_seconds", 0.0), 2),
+        "eval_s": _num(g.get("eval_seconds", 0.0), 2),
+        "ev/s": _num(g.get("evals_per_sec", 0.0), 1),
+        "segs": g.get("vm_segments", 0),
+    } for g in gens]
+    best = [float(g.get("best_score", 0.0)) for g in gens]
+    lines = [f"generations: {len(gens)}  "
+             "(dup=dup-suppressed sbx=sandbox-fail tpl=transpile-fail "
+             "rsf=rescore-fallback segs=vm-segments)"]
+    lines += _fmt_table(rows, ["gen", "best", "median", "p10", "new", "acc",
+                               "dup", "sbx", "tpl", "rsf", "llm_s", "eval_s",
+                               "ev/s", "segs"])
+    lines.append(f"fitness best {best[0]:.4f} -> {best[-1]:.4f}  "
+                 f"{sparkline(best)}")
+    return lines
+
+
+def _compile_section(events: List[Dict[str, Any]]) -> List[str]:
+    compiles = [e for e in events if e.get("kind") == "compile"]
+    if not compiles:
+        return []
+    by_key: Dict[str, List[float]] = {}
+    for e in compiles:
+        by_key.setdefault(e.get("key", "?"), []).append(
+            float(e.get("seconds", 0.0)))
+    lines = [f"compile events: {len(compiles)}"]
+    for key in sorted(by_key):
+        durs = by_key[key]
+        lines.append(f"  {key.split('/')[-1]}: {len(durs)}x "
+                     f"{sum(durs):.3f}s total")
+    return lines
+
+
+def _span_section(events: List[Dict[str, Any]]) -> List[str]:
+    spans = [e for e in events if e.get("kind") == "span"]
+    if not spans:
+        return []
+    agg: Dict[str, Dict[str, float]] = {}
+    for s in spans:
+        a = agg.setdefault(s.get("path", s.get("label", "?")),
+                           {"count": 0, "seconds": 0.0})
+        a["count"] += 1
+        a["seconds"] += float(s.get("seconds", 0.0))
+    lines = ["spans (by path, total wall):"]
+    for path, a in sorted(agg.items(), key=lambda kv: -kv[1]["seconds"]):
+        lines.append(f"  {path}: {int(a['count'])}x {a['seconds']:.3f}s")
+    return lines
+
+
+def _infra_section(events: List[Dict[str, Any]]) -> List[str]:
+    lines = []
+    devices = [e for e in events if e.get("kind") == "device"]
+    if devices:
+        plats: Dict[str, int] = {}
+        for d in devices:
+            plats[d.get("platform", "?")] = plats.get(
+                d.get("platform", "?"), 0) + 1
+        desc = ", ".join(f"{n}x {p}" for p, n in sorted(plats.items()))
+        mem = [d for d in devices if d.get("memory_stats")]
+        if mem:
+            used = sum(m["memory_stats"].get("bytes_in_use", 0) for m in mem)
+            desc += f"; {used / 2**20:.0f} MiB in use across {len(mem)}"
+        lines.append(f"devices: {desc}")
+    for e in events:
+        if e.get("kind") == "mesh":
+            waste = e.get("pad_waste_fraction")
+            lines.append(
+                f"mesh: {e.get('shards')} shards {e.get('shape')}"
+                + (f", pad waste {100 * waste:.1f}%"
+                   f" ({e.get('pad_lanes')}/{e.get('padded_count')} lanes)"
+                   if waste is not None else ""))
+    return lines
+
+
+def _bench_section(metrics: List[Dict[str, Any]]) -> List[str]:
+    stages = [m for m in metrics if m.get("kind") == "bench_stage"]
+    lines = []
+    for s in stages:
+        parts = [f"bench stage {s.get('stage', '?')}:"]
+        for k in ("evals_per_sec", "code_evals_per_sec", "compile_seconds",
+                  "first_call_seconds", "steady_state_seconds"):
+            if k in s:
+                parts.append(f"{k}={_num(float(s[k]), 3)}")
+        lines.append(" ".join(parts))
+    return lines
+
+
+def render_report(run_dir: str) -> str:
+    """The full run summary (see module docstring)."""
+    meta, events, metrics = load_run(run_dir)
+    head = (f"run {meta.get('run_id', '?')}"
+            f" [{meta.get('command', meta.get('metric', '?'))}]"
+            f" — status {meta.get('status', '?')}")
+    if "wall_seconds" in meta:
+        head += f", {meta['wall_seconds']}s"
+    lines = [head, f"started {meta.get('started', '?')}  dir {run_dir}"]
+    for key in ("argv", "best_score", "workload"):
+        if key in meta:
+            lines.append(f"{key}: {meta[key]}")
+    for section in (_infra_section(events), _generation_section(metrics),
+                    _bench_section(metrics), _compile_section(events),
+                    _span_section(events)):
+        if section:
+            lines.append("")
+            lines.extend(section)
+    if not events and not metrics:
+        lines += ["", "(no events or metrics recorded)"]
+    return "\n".join(lines)

@@ -1,0 +1,186 @@
+"""Compile/device telemetry: jax.monitoring listener + snapshot helpers.
+
+Population-based JAX stacks attribute their throughput claims to
+separating compile time from steady-state device time (PAPERS.md: evosax,
+arxiv 2212.04180; Fast PBRL, arxiv 2206.08888). This module captures that
+split from the host side, with zero instrumentation inside jitted code:
+
+- ``CompileWatcher``: a ``jax.monitoring`` duration listener that records
+  every jit compilation event (key, duration, running count) — the
+  ``/jax/core/compile/*`` family: jaxpr trace, MLIR lowering, backend
+  compile. Each event is appended to the active flight recorder as a
+  ``kind="compile"`` event and accumulated in-process for summaries.
+- ``device_snapshot``/``record_devices``: per-device identity plus
+  ``memory_stats()`` (None on backends that don't report, e.g. CPU).
+- ``mesh_snapshot``/``record_mesh``: mesh metadata — axis names/shape,
+  shard count, and the pad-lane waste fraction from
+  ``parallel.mesh.pad_stats`` (how many lanes of each launch are padding
+  duplicates rather than real candidates).
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Any, Dict, List, Optional
+
+import jax
+
+from fks_tpu.obs.recorder import get_recorder
+
+#: the jax.monitoring event-key family emitted per jit compilation
+COMPILE_PREFIX = "/jax/core/compile"
+#: the key measuring the actual XLA backend compile (vs trace/lowering)
+BACKEND_COMPILE = "backend_compile_duration"
+
+
+class CompileWatcher:
+    """Capture every jit compilation's (key, duration) while installed.
+
+    ``jax.monitoring`` listeners are global and additive; uninstall uses
+    the private-but-stable ``_unregister_event_duration_listener_by_
+    callback`` when available and otherwise leaves an inert callback
+    behind (the ``_installed`` gate makes it a no-op — never clear ALL
+    listeners, other subsystems may have their own).
+
+    Usable as a context manager::
+
+        with CompileWatcher(recorder) as w:
+            ...  # any jit compiles in here are captured
+        w.backend_compile_count, w.backend_compile_seconds
+    """
+
+    def __init__(self, recorder=None, prefix: str = COMPILE_PREFIX):
+        self.recorder = recorder if recorder is not None else get_recorder()
+        self.prefix = prefix
+        self.events: List[tuple] = []  # (key, seconds)
+        self._lock = threading.Lock()
+        self._installed = False
+
+    # the listener signature is (key, duration, **metadata) on this jax
+    def _listen(self, key: str, seconds: float, **kwargs) -> None:
+        if not self._installed or not key.startswith(self.prefix):
+            return
+        with self._lock:
+            self.events.append((key, float(seconds)))
+        self.recorder.event("compile", key=key, seconds=float(seconds))
+
+    def install(self) -> "CompileWatcher":
+        if not self._installed:
+            self._installed = True
+            jax.monitoring.register_event_duration_secs_listener(self._listen)
+        return self
+
+    def uninstall(self) -> None:
+        if not self._installed:
+            return
+        self._installed = False  # gate first: inert even if unregister fails
+        try:
+            from jax._src import monitoring as _monitoring
+            _monitoring._unregister_event_duration_listener_by_callback(
+                self._listen)
+        except Exception:  # pragma: no cover - private API moved
+            pass
+
+    def __enter__(self) -> "CompileWatcher":
+        return self.install()
+
+    def __exit__(self, *exc) -> None:
+        self.uninstall()
+
+    # ----- summaries
+
+    def summary(self) -> Dict[str, Dict[str, float]]:
+        """Per event key: {"count", "total_seconds"}."""
+        with self._lock:
+            events = list(self.events)
+        out: Dict[str, Dict[str, float]] = {}
+        for key, secs in events:
+            s = out.setdefault(key, {"count": 0, "total_seconds": 0.0})
+            s["count"] += 1
+            s["total_seconds"] += secs
+        for s in out.values():
+            s["total_seconds"] = round(s["total_seconds"], 6)
+        return out
+
+    @property
+    def backend_compile_count(self) -> int:
+        """XLA backend compiles observed (one per compiled program)."""
+        with self._lock:
+            return sum(1 for k, _ in self.events
+                       if k.endswith(BACKEND_COMPILE))
+
+    @property
+    def backend_compile_seconds(self) -> float:
+        """Total XLA backend compile time observed."""
+        with self._lock:
+            return sum(s for k, s in self.events
+                       if k.endswith(BACKEND_COMPILE))
+
+
+def watch_compiles(recorder=None):
+    """A ``CompileWatcher`` context for ``recorder`` — or a null context
+    when recording is disabled, so the no-run-dir path doesn't pay a
+    per-compile listener callback."""
+    rec = recorder if recorder is not None else get_recorder()
+    if not rec.enabled:
+        return contextlib.nullcontext(None)
+    return CompileWatcher(rec)
+
+
+# --------------------------------------------------------- snapshots
+
+def device_snapshot() -> List[Dict[str, Any]]:
+    """Per-device identity + ``memory_stats()`` (None where the backend
+    doesn't report — CPU — rather than raising)."""
+    out = []
+    for d in jax.devices():
+        try:
+            mem = d.memory_stats()
+        except Exception:  # pragma: no cover - backend without the API
+            mem = None
+        out.append({
+            "id": d.id,
+            "platform": d.platform,
+            "device_kind": getattr(d, "device_kind", ""),
+            "process_index": getattr(d, "process_index", 0),
+            "memory_stats": mem,
+        })
+    return out
+
+
+def record_devices(recorder=None) -> List[Dict[str, Any]]:
+    """Write one ``kind="device"`` event per visible device."""
+    rec = recorder if recorder is not None else get_recorder()
+    snap = device_snapshot() if rec.enabled else []
+    for d in snap:
+        rec.event("device", **d)
+    return snap
+
+
+def mesh_snapshot(mesh, real_count: Optional[int] = None) -> Dict[str, Any]:
+    """Mesh metadata: axes/shape/device count/shard count, plus the
+    pad-lane waste fraction when the caller's real candidate count is
+    known (``pad_population`` pads to a shard multiple; the waste fraction
+    is the share of launched lanes that are padding duplicates)."""
+    from fks_tpu.parallel.mesh import num_shards, pad_stats
+
+    info: Dict[str, Any] = {
+        "axis_names": list(mesh.axis_names),
+        "shape": {str(k): int(v) for k, v in mesh.shape.items()},
+        "devices": int(mesh.devices.size),
+        "shards": num_shards(mesh),
+    }
+    if real_count is not None:
+        info.update(pad_stats(real_count, mesh))
+    return info
+
+
+def record_mesh(mesh, real_count: Optional[int] = None,
+                recorder=None) -> Dict[str, Any]:
+    """Write one ``kind="mesh"`` event describing the mesh."""
+    rec = recorder if recorder is not None else get_recorder()
+    if not rec.enabled:
+        return {}
+    snap = mesh_snapshot(mesh, real_count)
+    rec.event("mesh", **snap)
+    return snap

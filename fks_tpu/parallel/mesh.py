@@ -155,6 +155,25 @@ def pad_population(params, num_shards):
     return params, c
 
 
+def pad_stats(real_count: int, num_shards) -> dict:
+    """Pad-lane accounting for a ``pad_population`` launch (pass the mesh
+    itself or a shard count): how many of the launched lanes are padding
+    duplicates of the last candidate rather than real work.
+    ``pad_waste_fraction`` is the device-time share spent on pad lanes —
+    the number the flight recorder's mesh snapshot reports
+    (fks_tpu.obs.telemetry.mesh_snapshot)."""
+    if isinstance(num_shards, Mesh):
+        num_shards = _num_shards(num_shards)
+    real = int(real_count)
+    padded = -(-real // num_shards) * num_shards if real else 0
+    return {
+        "real_count": real,
+        "padded_count": padded,
+        "pad_lanes": padded - real,
+        "pad_waste_fraction": (padded - real) / padded if padded else 0.0,
+    }
+
+
 def shard_population(params, mesh: Mesh):
     """``device_put`` every leaf of a candidate pytree with its leading
     (candidate) axis sharded over the mesh's pop axes. Identity layout for
@@ -316,7 +335,7 @@ def make_sharded_generation_step(workload: Workload, mesh: Mesh,
 def make_sharded_code_eval(workload: Workload, mesh: Mesh,
                            cfg: SimConfig = SimConfig(),
                            elite_k: int = 8, engine: str = "exact",
-                           seg_steps: int = 0):
+                           seg_steps: int = 0, on_segment=None):
     """Build ``eval(stacked, real_count) -> (result, elite_idx[K],
     elite_scores[K])`` for STACKED VM code candidates — the code-candidate
     analogue of ``make_sharded_eval``.
@@ -340,7 +359,9 @@ def make_sharded_code_eval(workload: Workload, mesh: Mesh,
     ``seg_steps > 0`` bounds each device call to ~``seg_steps`` events per
     dispatch (the FKS_VM_SEG_STEPS contract, for runtimes that kill long
     device executions); engines without segmented internals fall back to
-    the single-dispatch path.
+    the single-dispatch path. ``on_segment`` (zero-arg callable) fires on
+    the host after every segment dispatch — the flight recorder's segment
+    counter; ignored on the single-dispatch path.
     """
     from fks_tpu.funsearch import vm
     from fks_tpu.sim import get_engine
@@ -348,7 +369,7 @@ def make_sharded_code_eval(workload: Workload, mesh: Mesh,
     mod = get_engine(engine)
     if seg_steps > 0 and hasattr(mod, "make_segmented_population_run"):
         return _make_segmented_code_eval(workload, mesh, cfg, elite_k, mod,
-                                         seg_steps)
+                                         seg_steps, on_segment)
 
     run = mod.make_population_run_fn(workload, vm.score_static, cfg)
     state0 = mod.initial_state(workload, cfg)
@@ -378,7 +399,8 @@ def make_sharded_code_eval(workload: Workload, mesh: Mesh,
 
 
 def _make_segmented_code_eval(workload: Workload, mesh: Mesh, cfg: SimConfig,
-                              elite_k: int, mod, seg_steps: int):
+                              elite_k: int, mod, seg_steps: int,
+                              on_segment=None):
     """The segmented body of ``make_sharded_code_eval``: a host loop of
     jitted shard_map'd segments — ``flat.make_segmented_population_run``
     mirrored one level up, at the mesh. Per segment every shard advances
@@ -447,6 +469,8 @@ def _make_segmented_code_eval(workload: Workload, mesh: Mesh, cfg: SimConfig,
         active = True
         for _ in range(-(-max_steps // seg_steps) + 1):
             bstate, active = advance(stacked, bstate)
+            if on_segment is not None:
+                on_segment()
             if not bool(active):  # the only per-segment host sync
                 break
         if bool(active):

@@ -486,7 +486,8 @@ def make_population_run_fn(workload: Workload, param_policy,
 
 def make_segmented_population_run(workload: Workload, param_policy,
                                   cfg: SimConfig = SimConfig(),
-                                  seg_steps: int = 4096):
+                                  seg_steps: int = 4096,
+                                  on_segment=None):
     """``make_population_run_fn`` with a bounded device-call length: the
     while_loop stops every ``seg_steps`` events and the carry returns to
     the host, which re-dispatches until every lane drains.
@@ -505,6 +506,10 @@ def make_segmented_population_run(workload: Workload, param_policy,
     Results are identical to the unsegmented runner: the carry is the
     same, only the while_loop is split (pinned by
     tests/test_flat_engine.py::test_segmented_population_matches).
+
+    ``on_segment`` (zero-arg callable) fires on the host after every
+    segment dispatch — the flight recorder's segment counter
+    (fks_tpu.obs); it runs between device calls, never inside them.
     """
     if seg_steps <= 0:
         raise ValueError(
@@ -546,6 +551,8 @@ def make_segmented_population_run(workload: Workload, param_policy,
         active = True
         for _ in range(-(-max_steps // seg_steps) + 1):
             bstate, active = advance(params, bstate)
+            if on_segment is not None:
+                on_segment()
             if not bool(active):  # the only per-segment host sync
                 break
         if bool(active):

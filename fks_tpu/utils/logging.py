@@ -19,10 +19,26 @@ from __future__ import annotations
 import json
 import logging
 import os
+import threading
 import time
 from typing import IO, Any, Dict, Optional
 
 _CONFIGURED = False
+
+
+def json_ready(obj: Any) -> Any:
+    """``json.dumps`` ``default=`` hook: coerce numpy/jax leaves to plain
+    Python. Scalars (``np.float32(...)``, 0-d ``jnp`` arrays, ``np.bool_``)
+    become their Python value via ``.item()``; array leaves become nested
+    lists via ``.tolist()``. Anything else re-raises ``TypeError`` exactly
+    as ``json.dumps`` would, so genuinely unserializable records still fail
+    loudly instead of silently degrading."""
+    if getattr(obj, "ndim", None) == 0 and hasattr(obj, "item"):
+        return obj.item()
+    if hasattr(obj, "tolist"):
+        return obj.tolist()
+    raise TypeError(
+        f"Object of type {type(obj).__name__} is not JSON serializable")
 
 
 def get_logger(name: str = "fks_tpu") -> logging.Logger:
@@ -86,6 +102,9 @@ class MetricsWriter:
         else:
             self._f = path_or_stream
             self._owns = False
+        # writers are shared across threads (compile listeners fire from
+        # the evaluator's thread pool); one line per write call, atomically
+        self._lock = threading.Lock()
 
     def write(self, kind: str, record: Optional[Dict[str, Any]] = None,
               **fields) -> None:
@@ -93,8 +112,13 @@ class MetricsWriter:
         if record:
             rec.update(record)
         rec.update(fields)
-        self._f.write(json.dumps(rec) + "\n")
-        self._f.flush()
+        # json_ready: metric values routinely arrive as numpy/jax scalars
+        # (``write(kind, score=jnp.float32(...))`` must emit a plain float,
+        # not raise TypeError)
+        line = json.dumps(rec, default=json_ready) + "\n"
+        with self._lock:
+            self._f.write(line)
+            self._f.flush()
 
     def close(self) -> None:
         if self._owns:

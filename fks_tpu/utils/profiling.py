@@ -37,11 +37,17 @@ class Timing:
 
 
 @contextlib.contextmanager
-def timed(label: str = "", sync: Any = None) -> Iterator[Timing]:
+def timed(label: str = "", sync: Any = None,
+          on_exit: Any = None) -> Iterator[Timing]:
     """Measure a block's wall time. For device work, register the block's
     output via ``t.sync(...)`` so the clock includes the actual compute
     (JAX dispatch is async; without a sync the delta measures enqueue
     time). ``sync=`` covers values that already exist at entry.
+
+    ``on_exit`` (``Callable[[Timing], None]``) fires after the clock stops,
+    device sync included — the extension point ``fks_tpu.obs.span`` builds
+    its flight-recorder span events on (nesting, xprof mirroring, and the
+    run-dir event live there; this stays the bare mechanism).
 
     >>> with timed("eval") as t:
     ...     result = t.sync(ev(params))
@@ -55,6 +61,8 @@ def timed(label: str = "", sync: Any = None) -> Iterator[Timing]:
         if out._sync is not None:
             jax.block_until_ready(out._sync)
         out.seconds = time.perf_counter() - t0
+        if on_exit is not None:
+            on_exit(out)
 
 
 def block_timed(fn, *args, **kwargs):

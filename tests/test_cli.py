@@ -155,6 +155,82 @@ def test_metrics_bad_path_fails_fast(micro_cli, tmp_path):
                   "--metrics", str(tmp_path)])
 
 
+def test_evolve_run_dir_then_report_smoke(micro_cli, tmp_path, capsys):
+    """Tier-1 smoke (ISSUE 2 satellite): evolve --run-dir writes a valid
+    flight-recorder directory, every JSONL line parses against the schema
+    helper, and `cli report` renders the summary from the files alone."""
+    run_dir = tmp_path / "run"
+    rc = cli.main(["evolve", "--fake-llm", "--generations", "2",
+                   "--engine", "exact", "--run-dir", str(run_dir)])
+    assert rc == 0
+    capsys.readouterr()
+
+    # layout + line-by-line schema via the reusable tools/ helper
+    import os
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "tools"))
+    try:
+        import check_jsonl_schema as cjs
+    finally:
+        sys.path.pop(0)
+    counts = cjs.check_run_dir(str(run_dir))
+    assert counts["metrics.jsonl"] >= 2  # one ledger row per generation
+    assert counts["events.jsonl"] >= 1
+    assert counts["heartbeat"] == 1
+
+    meta = json.loads((run_dir / "meta.json").read_text())
+    assert meta["command"] == "evolve"
+    assert meta["status"] == "ok"
+    assert "best_score" in meta
+    gens = [json.loads(l) for l
+            in (run_dir / "metrics.jsonl").read_text().splitlines()
+            if json.loads(l)["kind"] == "generation"]
+    assert [g["generation"] for g in gens] == [1, 2]
+    for key in ("median_score", "p10_score", "sandbox_failed",
+                "transpile_failed", "rescore_fallbacks", "llm_seconds",
+                "programs_compiled", "vm_segments"):
+        assert key in gens[0], key
+    kinds = {json.loads(l)["kind"] for l
+             in (run_dir / "events.jsonl").read_text().splitlines()}
+    assert "span" in kinds and "device" in kinds
+    assert "compile" in kinds  # jax.monitoring listener captured compiles
+
+    rc = cli.main(["report", str(run_dir)])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "generations: 2" in out
+    assert "status ok" in out
+    assert "spans (by path" in out
+    assert "compile events:" in out
+    assert "fitness best" in out
+
+    # a non-run directory errors cleanly, not with a traceback
+    assert cli.main(["report", str(tmp_path / "nope")]) == 2
+
+
+def test_scale_run_dir_records_mesh(tmp_path, capsys):
+    run_dir = tmp_path / "run"
+    rc = cli.main(["scale", "--nodes-count", "8", "--pods-count", "80",
+                   "--pop", "5", "--seed", "1", "--run-dir", str(run_dir)])
+    assert rc == 0
+    capsys.readouterr()
+    events = [json.loads(l) for l
+              in (run_dir / "events.jsonl").read_text().splitlines()]
+    mesh = [e for e in events if e["kind"] == "mesh"]
+    assert mesh and mesh[0]["shards"] == 8
+    # pop 5 on 8 shards pads 3 lanes
+    assert mesh[0]["pad_lanes"] == 3
+    assert mesh[0]["pad_waste_fraction"] == pytest.approx(3 / 8)
+    rows = [json.loads(l) for l
+            in (run_dir / "metrics.jsonl").read_text().splitlines()]
+    assert rows[-1]["kind"] == "scale" and rows[-1]["evals_per_sec"] > 0
+    rc = cli.main(["report", str(run_dir)])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "mesh: 8 shards" in out and "pad waste 37.5%" in out
+
+
 def test_divergence_bound_reads_latest_row(tmp_path):
     p = tmp_path / "audit.jsonl"
     rows = [{"trace": "t.csv", "max_abs_d": 0.01},

@@ -245,3 +245,56 @@ def test_run_entry_point_with_checkpoint(tmp_path):
     fs2 = evo.run(micro_workload(), cfg, backend=FakeLLM(3),
                   checkpoint_path=ck, log=quiet)
     assert fs2.generation == 1  # already at generation budget; no extra gens
+
+
+# ---------------------------------------------------- ISSUE 2: observability
+
+def test_generation_stats_failure_classification():
+    """EvalRecord errors split into transpile-fail (static rejection) vs
+    sandbox-fail (raised while running) by prefix."""
+    from fks_tpu.funsearch.backend import EvalRecord
+    from fks_tpu.funsearch.evolution import _failure_counts
+
+    records = [
+        EvalRecord("a", 0.5, None),
+        EvalRecord("b", 0.0, "syntax: invalid syntax"),
+        EvalRecord("c", 0.0, "transpile: unsupported node"),
+        EvalRecord("d", 0.0, "runtime: ZeroDivisionError"),
+        EvalRecord("e", 0.0, "gpu allocation aborted"),
+    ]
+    sandbox, transpile = _failure_counts(records)
+    assert transpile == 2
+    assert sandbox == 2
+
+
+def test_generation_stats_extended_fields(evaluator):
+    fs = make_fs(evaluator)
+    fs.initialize_population()
+    stats = fs.evolve_generation()
+    assert stats.p10_score <= stats.median_score <= stats.best_score
+    assert stats.median_score > 0  # seeds score positive on the micro trace
+    assert stats.sandbox_failed >= 0 and stats.transpile_failed >= 0
+    assert stats.rescore_fallbacks == 0  # exact engine: no rescoring at all
+    assert stats.llm_seconds >= 0
+    # the ledger row carries every dataclass field + evaluator deltas
+    row = fs.ledger.generation_record(stats)
+    import dataclasses
+    for f in dataclasses.fields(stats):
+        assert f.name in row
+    assert "programs_compiled" in row and "vm_segments" in row
+
+
+def test_rescore_fallback_counter(evaluator, monkeypatch):
+    """A transiently failing exact rescore increments the counter (and the
+    per-generation delta lands in stats)."""
+    fs = make_fs(evaluator)
+    fs.evaluator = type(fs.evaluator)(micro_workload(), engine="flat")
+    monkeypatch.setattr(
+        type(fs.evaluator), "evaluate_one",
+        lambda self, code: (_ for _ in ()).throw(RuntimeError("wedged")),
+        raising=False)
+    before = fs.rescore_fallbacks
+    got = fs._exact_score("def priority_function(pod, node):\n    return 1\n",
+                          0.42)
+    assert got == 0.42  # falls back to the search fitness
+    assert fs.rescore_fallbacks == before + 1

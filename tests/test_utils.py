@@ -74,6 +74,89 @@ def test_metrics_writer_jsonl(tmp_path):
     assert lines[1]["best_score"] == 0.9
 
 
+def test_throughput_meter_rate_none_at_zero_seconds():
+    """Zero accumulated time must yield None, not ZeroDivisionError — a
+    sub-resolution timed rep (perf_counter delta 0.0) feeds this."""
+    m = ThroughputMeter()
+    m.add(10, 0.0)
+    assert m.rate is None
+    assert m.summary() == "10 in 0.00s"
+    m.add(10, 2.0)
+    assert m.rate == 10.0  # 20 items / 2 s total
+
+
+def test_block_timed_pytree_result(monkeypatch):
+    """block_timed must materialize EVERY leaf of a pytree result (dicts/
+    tuples of arrays), not just a lone array."""
+    from fks_tpu.utils import profiling
+
+    synced = []
+    real = jax.block_until_ready
+    monkeypatch.setattr(profiling.jax, "block_until_ready",
+                        lambda v: (synced.append(v), real(v))[1])
+    tree, secs = block_timed(
+        lambda a: {"x": a + 1, "pair": (a * 2, a.sum())}, jnp.ones(4))
+    assert float(tree["x"][0]) == 2.0
+    assert float(tree["pair"][0][0]) == 2.0
+    assert float(tree["pair"][1]) == 4.0
+    assert secs > 0
+    assert len(synced) == 1 and synced[0] is tree  # whole tree, one call
+
+
+def test_device_trace_noop_when_profiler_unavailable(tmp_path, monkeypatch):
+    """A backend without profiler support must not break the traced block,
+    and stop_trace must not be called for a trace that never started."""
+    from fks_tpu.utils import profiling
+
+    stopped = []
+    monkeypatch.setattr(
+        profiling.jax.profiler, "start_trace",
+        lambda d: (_ for _ in ()).throw(RuntimeError("no profiler")))
+    monkeypatch.setattr(profiling.jax.profiler, "stop_trace",
+                        lambda: stopped.append(True))
+    ran = []
+    with profiling.device_trace(str(tmp_path)):
+        ran.append(True)
+    assert ran == [True]
+    assert stopped == []  # never started => never stopped
+
+
+def test_device_trace_stops_started_trace(tmp_path, monkeypatch):
+    from fks_tpu.utils import profiling
+
+    calls = []
+    monkeypatch.setattr(profiling.jax.profiler, "start_trace",
+                        lambda d: calls.append(("start", d)))
+    monkeypatch.setattr(profiling.jax.profiler, "stop_trace",
+                        lambda: calls.append(("stop",)))
+    with profiling.device_trace(str(tmp_path)):
+        pass
+    assert calls == [("start", str(tmp_path)), ("stop",)]
+
+
+def test_metrics_writer_coerces_accelerator_scalars(tmp_path):
+    """Satellite fix: numpy/jax scalar fields must serialize instead of
+    crashing json.dumps (device results leak into metric records)."""
+    import numpy as np
+
+    path = tmp_path / "m.jsonl"
+    with MetricsWriter(str(path)) as w:
+        w.write("bench", score=np.float32(0.5), n=np.int64(7),
+                arr=np.arange(3), jscore=jnp.float32(0.25),
+                jarr=jnp.arange(2))
+    row = json.loads(path.read_text().splitlines()[0])
+    assert row["score"] == 0.5 and row["n"] == 7
+    assert row["arr"] == [0, 1, 2]
+    assert row["jscore"] == 0.25 and row["jarr"] == [0, 1]
+
+
+def test_metrics_writer_rejects_unserializable():
+    from fks_tpu.utils.logging import json_ready
+
+    with pytest.raises(TypeError):
+        json_ready(object())
+
+
 @pytest.mark.slow
 def test_result_record_schema(default_workload):
     from fks_tpu.models import zoo

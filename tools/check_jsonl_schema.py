@@ -1,0 +1,147 @@
+"""JSONL schema checker for flight-recorder run dirs and results files.
+
+Two jobs, one helper:
+
+- ``check_jsonl(path, required=...)`` — every line must parse as a JSON
+  object carrying the required keys. A torn FINAL line (a writer killed
+  mid-append) is tolerated by default, matching ``obs.report.read_jsonl``;
+  a torn line anywhere else is corruption and fails.
+- ``check_run_dir(run_dir)`` — validate a ``fks_tpu.obs.FlightRecorder``
+  directory: ``meta.json`` (run_id/started/status), ``events.jsonl`` and
+  ``metrics.jsonl`` (ts/kind per line), ``heartbeat`` when present.
+
+Usage:
+    python tools/check_jsonl_schema.py --run-dir runs/evolve1
+    python tools/check_jsonl_schema.py benchmarks/results/round*_tpu.jsonl
+
+The second form checks arbitrary JSONL evidence files (the TPU session
+logs under benchmarks/results/ predate the recorder and have no fixed
+keys, so they are checked for parseability only unless --require is
+given). Exit code 0 = clean, 1 = violations (printed one per line).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict, List, Sequence, Tuple
+
+#: per-line required keys for the recorder's JSONL surfaces
+RUN_DIR_REQUIRED: Dict[str, Tuple[str, ...]] = {
+    "events.jsonl": ("ts", "kind"),
+    "metrics.jsonl": ("ts", "kind"),
+}
+#: required keys in a run dir's meta.json
+META_REQUIRED: Tuple[str, ...] = ("run_id", "started", "status")
+
+
+class SchemaError(ValueError):
+    """A JSONL file violated the schema; ``str(e)`` says where and why."""
+
+
+def check_jsonl(path: str, required: Sequence[str] = (),
+                allow_empty: bool = True,
+                tolerate_torn_tail: bool = True) -> List[dict]:
+    """Parse ``path`` line by line, requiring each record to be a JSON
+    object with every key in ``required``. Returns the parsed records.
+    Raises ``SchemaError`` on the first violation (with line number)."""
+    try:
+        with open(path) as f:
+            lines = f.readlines()
+    except OSError as e:
+        raise SchemaError(f"{path}: unreadable ({e})") from e
+    if not lines and not allow_empty:
+        raise SchemaError(f"{path}: empty")
+    records: List[dict] = []
+    last = len(lines) - 1
+    for i, line in enumerate(lines):
+        if not line.strip():
+            if i == last:
+                continue  # trailing newline
+            raise SchemaError(f"{path}:{i + 1}: blank line mid-file")
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError as e:
+            if i == last and tolerate_torn_tail:
+                break  # writer killed mid-append; the prefix is valid
+            raise SchemaError(f"{path}:{i + 1}: unparsable ({e})") from e
+        if not isinstance(rec, dict):
+            raise SchemaError(f"{path}:{i + 1}: not a JSON object "
+                              f"({type(rec).__name__})")
+        missing = [k for k in required if k not in rec]
+        if missing:
+            raise SchemaError(f"{path}:{i + 1}: missing {missing} "
+                              f"(has {sorted(rec)[:8]})")
+        records.append(rec)
+    return records
+
+
+def check_run_dir(run_dir: str) -> Dict[str, int]:
+    """Validate a FlightRecorder run directory; returns per-file record
+    counts. Raises ``SchemaError`` on the first violation."""
+    meta_path = os.path.join(run_dir, "meta.json")
+    try:
+        with open(meta_path) as f:
+            meta = json.load(f)
+    except OSError as e:
+        raise SchemaError(f"{meta_path}: unreadable ({e})") from e
+    except json.JSONDecodeError as e:
+        raise SchemaError(f"{meta_path}: unparsable ({e})") from e
+    missing = [k for k in META_REQUIRED if k not in meta]
+    if missing:
+        raise SchemaError(f"{meta_path}: missing {missing}")
+    counts = {"meta.json": 1}
+    for name, required in RUN_DIR_REQUIRED.items():
+        path = os.path.join(run_dir, name)
+        if not os.path.exists(path):
+            counts[name] = 0  # a run may legitimately record no metrics
+            continue
+        counts[name] = len(check_jsonl(path, required=required))
+    hb = os.path.join(run_dir, "heartbeat")
+    if os.path.exists(hb):
+        try:
+            with open(hb) as f:
+                beat = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            raise SchemaError(f"{hb}: unparsable ({e})") from e
+        if "ts" not in beat:
+            raise SchemaError(f"{hb}: missing ['ts']")
+        counts["heartbeat"] = 1
+    return counts
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("paths", nargs="*",
+                    help="JSONL files to check (e.g. benchmarks/results/"
+                         "round*_tpu.jsonl)")
+    ap.add_argument("--run-dir", default="",
+                    help="validate a flight-recorder run directory instead")
+    ap.add_argument("--require", default="",
+                    help="comma-separated keys every record must carry")
+    args = ap.parse_args(argv)
+    if not args.run_dir and not args.paths:
+        ap.error("give JSONL paths or --run-dir")
+    required = [k for k in args.require.split(",") if k]
+    rc = 0
+    if args.run_dir:
+        try:
+            counts = check_run_dir(args.run_dir)
+            print(f"{args.run_dir}: ok "
+                  + " ".join(f"{k}={v}" for k, v in sorted(counts.items())))
+        except SchemaError as e:
+            print(f"SCHEMA: {e}", file=sys.stderr)
+            rc = 1
+    for path in args.paths:
+        try:
+            records = check_jsonl(path, required=required)
+            print(f"{path}: ok ({len(records)} records)")
+        except SchemaError as e:
+            print(f"SCHEMA: {e}", file=sys.stderr)
+            rc = 1
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
