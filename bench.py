@@ -72,6 +72,19 @@ Contract hardening (round 3): the controller installs SIGTERM/SIGINT/
 SIGHUP handlers that print the fallback JSON line before exiting, so even
 an outer `timeout`-style kill (BENCH_r02: rc=124, parsed:null) leaves one
 parsable record on stdout. Only SIGKILL can now produce an empty record.
+
+Failure taxonomy (round 7): when every probe attempt fails, the fallback
+line additionally carries ``failure_taxonomy`` — per-attempt structured
+records classified as timeout / sigill-risk (killed by signal) /
+import-error / init-failure — so a post-mortem can tell a wedged tunnel
+from a broken install without the stderr log. Each failed attempt is
+also recorded as a ``probe_failure`` event when FKS_RUN_DIR is set.
+
+Regression gating: ``python bench.py --gate BASELINE`` judges this run's
+headline against a prior bench JSONL (or a flight-recorder run dir)
+through fks_tpu.obs.compare; the verdict table goes to stderr, stdout
+keeps the single-JSON-line contract, and a regression (default: >10%
+evals/s drop) exits nonzero.
 """
 import json
 import os
@@ -157,7 +170,7 @@ def _banked_measurement():
     return best, code_best
 
 
-def _fallback_json(error: str) -> str:
+def _fallback_json(error: str, failure_taxonomy=None) -> str:
     """The benchmark's single-JSON-line contract, error form. The
     headline ``value``/``vs_baseline`` stay 0.0 — a failed probe measured
     nothing, and a banked number in the headline reads as a live result
@@ -176,6 +189,15 @@ def _fallback_json(error: str) -> str:
         banked = code_banked = None
     payload = {"metric": METRIC, "value": 0.0, "unit": "evals/s",
                "vs_baseline": 0.0, "error": error}
+    if failure_taxonomy:
+        # structured per-attempt probe failures (kind: timeout /
+        # sigill-risk / import-error / init-failure) — the last error
+        # string alone erased WHICH way the device went away
+        kinds = {}
+        for a in failure_taxonomy:
+            kinds[a["kind"]] = kinds.get(a["kind"], 0) + 1
+        payload["failure_taxonomy"] = {"kinds": kinds,
+                                       "attempts": failure_taxonomy}
     if banked is not None:
         payload["banked_from"] = banked
         payload["note"] = ("no live probe this run; the current round's "
@@ -240,8 +262,8 @@ def _record(method: str, *a, **kw) -> None:
             pass
 
 
-def _fail(error: str) -> int:
-    _print_result(_fallback_json(error))
+def _fail(error: str, failure_taxonomy=None) -> int:
+    _print_result(_fallback_json(error, failure_taxonomy))
     _record("annotate_meta", error=error)
     _record("finish", "error")
     _record("close")
@@ -275,6 +297,34 @@ def _install_kill_writeahead():
             pass
 
 
+def _classify_probe_failure(returncode, stderr: str):
+    """Structured failure taxonomy for one probe attempt (round-7: the
+    fallback JSON previously carried only the LAST error string, erasing
+    whether the probe timed out, crashed on a signal, or never imported):
+
+    - ``timeout``       — subprocess exceeded its deadline (wedged tunnel)
+    - ``sigill-risk``   — killed by a signal (negative returncode): the
+                          classic symptom of an ISA mismatch / SIGILL or
+                          an OOM SIGKILL, either of which would also kill
+                          the throughput stage
+    - ``import-error``  — jax (or a transitive dep) failed to import
+    - ``init-failure``  — imported fine, backend initialization raised
+    """
+    if returncode is None:
+        return "timeout", "device backend initialization timed out"
+    if returncode < 0:
+        sig = -returncode
+        try:
+            name = signal.Signals(sig).name
+        except ValueError:
+            name = str(sig)
+        return "sigill-risk", f"probe killed by signal {name}"
+    tail = (stderr or "")[-2000:]
+    if "ImportError" in tail or "ModuleNotFoundError" in tail:
+        return "import-error", "jax import failed in probe subprocess"
+    return "init-failure", f"backend initialization failed (rc={returncode})"
+
+
 def _probe_backend(budget_s: int):
     """The axon TPU tunnel can WEDGE (hang indefinitely) after a killed
     device execution; backend init then blocks forever. Probe device
@@ -283,11 +333,15 @@ def _probe_backend(budget_s: int):
     finishes the orphaned execution, so retry while the budget lasts.
     ALL attempts and inter-attempt sleeps stay inside ``budget_s`` (the
     controller promises the driver a JSON line within its deadline).
-    Returns ``(error, platform)``: (None, "tpu"/"cpu"/...) when healthy,
-    (error string, None) otherwise."""
+    Returns ``(error, platform, attempts)``: (None, "tpu"/"cpu"/...,
+    [...]) when healthy, (error string, None, [...]) otherwise —
+    ``attempts`` is the structured per-attempt failure record
+    (``{"attempt", "kind", "detail"}``, see ``_classify_probe_failure``)
+    that rides into the fallback JSON and the flight recorder."""
     deadline = time.monotonic() + budget_s
     last = None
     attempt = 0
+    attempts = []
     while True:
         remaining = deadline - time.monotonic()
         if remaining < 10:
@@ -299,18 +353,28 @@ def _probe_backend(budget_s: int):
                  "import jax; print(jax.devices()[0].platform)"],
                 timeout=min(120, remaining), capture_output=True, text=True)
         except subprocess.TimeoutExpired:
-            last = "device backend initialization timed out (wedged tunnel?)"
+            kind, detail = _classify_probe_failure(None, "")
+            last = f"{detail} (wedged tunnel?)"
+            attempts.append({"attempt": attempt, "kind": kind,
+                             "detail": last})
+            _record("event", "probe_failure", attempt=attempt, kind=kind,
+                    detail=last)
             log(f"backend probe attempt {attempt}: {last}")
             continue
         if r.returncode != 0:
-            last = f"device backend initialization failed (rc={r.returncode})"
-            log(f"backend probe attempt {attempt} rc={r.returncode}:"
-                f"\n{r.stderr[-2000:]}")
+            kind, detail = _classify_probe_failure(r.returncode, r.stderr)
+            last = detail
+            attempts.append({"attempt": attempt, "kind": kind,
+                             "detail": detail})
+            _record("event", "probe_failure", attempt=attempt, kind=kind,
+                    detail=detail, rc=r.returncode)
+            log(f"backend probe attempt {attempt} [{kind}] "
+                f"rc={r.returncode}:\n{r.stderr[-2000:]}")
             time.sleep(max(0, min(30, deadline - time.monotonic())))
             continue
         plat = r.stdout.strip().splitlines()[-1] if r.stdout.strip() else ""
-        return None, plat
-    return (last or "backend probe budget exhausted"), None
+        return None, plat, attempts
+    return (last or "backend probe budget exhausted"), None, attempts
 
 
 # ---------------------------------------------------------------- stages
@@ -558,10 +622,44 @@ def _run_stage(stage: str, env_extra: dict, timeout_s: int):
     return r.stdout
 
 
+def _gate(baseline: str, payload: dict) -> int:
+    """``bench.py --gate BASELINE``: judge this run's headline against a
+    baseline (a prior bench JSONL or a flight-recorder run dir) through
+    the shared comparator (fks_tpu.obs.compare). The verdict table goes
+    to stderr — stdout keeps the single-JSON-line contract — and a
+    regression turns the exit code nonzero."""
+    import tempfile
+
+    try:
+        from fks_tpu.obs.compare import (
+            compare_runs, format_comparison, has_regression,
+        )
+        fd, tmp = tempfile.mkstemp(suffix=".jsonl")
+        try:
+            with os.fdopen(fd, "w") as f:
+                f.write(json.dumps(payload) + "\n")
+            rows = compare_runs(baseline, tmp)
+        finally:
+            os.unlink(tmp)
+    except Exception as e:  # noqa: BLE001 — a broken gate must not erase
+        log(f"--gate failed: {type(e).__name__}: {e}")  # the printed result
+        return 1
+    log(format_comparison(rows, baseline, "<this bench run>"))
+    if has_regression(rows):
+        _record("event", "alert", source="bench_gate", baseline=baseline,
+                regressions=[r["metric"] for r in rows
+                             if r["status"] == "REGRESSION"])
+        return 1
+    return 0
+
+
 def main():
     stage = ""
     if "--stage" in sys.argv:
         stage = sys.argv[sys.argv.index("--stage") + 1]
+    gate = ""
+    if "--gate" in sys.argv:
+        gate = sys.argv[sys.argv.index("--gate") + 1]
     pop = int(os.environ.get("FKS_BENCH_POP", "512"))
     chunk = min(int(os.environ.get("FKS_BENCH_CHUNK", "256")), pop)
     reps = int(os.environ.get("FKS_BENCH_REPS", "2"))
@@ -605,10 +703,10 @@ def main():
         return _fail("parity gate did not pass (fitness mismatch, "
                      "timeout, or crash — see stderr)")
 
-    err, platform = _probe_backend(budget_s=max(30, budget() - 180))
+    err, platform, attempts = _probe_backend(budget_s=max(30, budget() - 180))
     if err:
         log(f"backend probe: {err}")
-        return _fail(err)
+        return _fail(err, failure_taxonomy=attempts)
     log(f"device platform: {platform}")
 
     # "auto": try the fused Pallas kernel first, falling back to the XLA
@@ -644,10 +742,10 @@ def main():
         if budget() < 120:
             return _fail("benchmark deadline exhausted")
         # keep the probe inside the deadline too (leave room for the rerun)
-        err, _ = _probe_backend(budget_s=max(30, budget() - 180))
+        err, _, attempts = _probe_backend(budget_s=max(30, budget() - 180))
         if err:
             log(f"backend probe: {err}")
-            return _fail(err)
+            return _fail(err, failure_taxonomy=attempts)
 
     stage_res = None
     for line in reversed(out.strip().splitlines()):
@@ -711,10 +809,13 @@ def main():
     _record("metric", "headline", payload)
     _record("annotate_meta", value=payload["value"],
             vs_baseline=payload["vs_baseline"])
+    rc = 0
+    if gate:
+        rc = _gate(gate, payload)
     _record("finish", "ok")
     _record("close")
     _print_result(json.dumps(payload))
-    return 0
+    return rc
 
 
 if __name__ == "__main__":

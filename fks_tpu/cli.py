@@ -14,11 +14,17 @@ Usage:
     python -m fks_tpu.cli evolve [--config F] [--fake-llm] [--checkpoint F]
     python -m fks_tpu.cli scale [--nodes-count N] [--pods-count P] [--pop C]
     python -m fks_tpu.cli report RUN_DIR
+    python -m fks_tpu.cli export-metrics RUN_DIR [--out F]
+    python -m fks_tpu.cli watch RUN_DIR [--interval S] [--once]
+    python -m fks_tpu.cli compare BASELINE CANDIDATE [--threshold m=rel:X]
     python -m fks_tpu.cli traces
 
 Every subcommand accepts ``--run-dir DIR`` to flight-record the run
 (fks_tpu.obs): spans, compile/device telemetry, and per-generation
-evolution ledger land in DIR as JSONL; ``report DIR`` renders the summary.
+evolution ledger land in DIR as JSONL; ``report DIR`` renders the summary,
+``export-metrics`` emits OpenMetrics text, ``watch`` live-tails with a
+heartbeat liveness verdict, and ``compare`` gates a candidate run against
+a baseline (nonzero exit on regression).
 """
 from __future__ import annotations
 
@@ -295,6 +301,10 @@ def cmd_evolve(args):
         cfg.generations = args.generations
     if args.parametric_rounds is not None:
         cfg.parametric_rounds = args.parametric_rounds
+    if args.parity_sample is not None:
+        cfg.parity_sample = args.parity_sample
+    if args.parity_tol is not None:
+        cfg.parity_tol = args.parity_tol
     backend = FakeLLM(seed=cfg.seed) if args.fake_llm else None
     if backend is None and not cfg.llm.api_key:
         print("no API key in config; use --fake-llm for hermetic runs",
@@ -342,13 +352,16 @@ def cmd_evolve(args):
                 # streamed per generation: an interrupted evolution still
                 # leaves a complete metric trail up to the crash point
                 metrics.write("generation", dataclasses.asdict(st))
-        fs = evo.run(wl, cfg, backend=backend, sim_config=SimConfig(),
+        fs = evo.run(wl, cfg, backend=backend,
+                     sim_config=SimConfig(watchdog=args.watchdog),
                      checkpoint_path=args.checkpoint, out_dir=args.out,
                      engine=args.engine, on_generation=on_gen)
         if fs.best:
             rec.annotate_meta(best_score=fs.best[1],
                               best_exact=fs.best_exact,
                               generations=fs.generation)
+        if fs.sentinel.alerts:
+            rec.annotate_meta(parity_alerts=fs.sentinel.alerts)
     if fs.best:
         print(f"best fitness: {fs.best[1]:.4f}")
         # on interrupt evo.run already persisted champions — don't double-save
@@ -356,6 +369,16 @@ def cmd_evolve(args):
             path = fs.save_top_policies(args.out, k=5)
             print(f"saved top policies to {path}")
             print(f"saved best policy to {fs.save_best_policy(args.out)}")
+    if fs.sentinel.alerts:
+        # the parity sentinel's nonzero-exit policy: drift beyond the
+        # tolerance means the fitness selection trusted disagrees with the
+        # exact reference evaluator — champions are saved above, but the
+        # run must not read as clean to CI/driver scripts
+        print(f"PARITY ALERT: {fs.sentinel.alerts} generation(s) exceeded "
+              f"drift tolerance {cfg.parity_tol:g} (max drift "
+              f"{fs.sentinel.max_drift:.3g}); see the run dir's alert "
+              "events", file=sys.stderr)
+        return 3
     return 0
 
 
@@ -490,6 +513,66 @@ def cmd_report(args):
     return 0
 
 
+def cmd_export_metrics(args):
+    """Render a flight-recorder run directory as OpenMetrics text
+    exposition (``# TYPE``/``# HELP`` blocks, ``# EOF`` terminator) —
+    scrape-able by any Prometheus textfile collector, no client library."""
+    from fks_tpu.obs import to_openmetrics
+
+    try:
+        text = to_openmetrics(args.run_dir)
+    except (FileNotFoundError, ValueError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    if args.out:
+        # atomic replace: a scraper must never read a half-written file
+        import os
+        tmp = args.out + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(text)
+        os.replace(tmp, args.out)
+        print(f"wrote {args.out}", file=sys.stderr)
+    else:
+        sys.stdout.write(text)
+    return 0
+
+
+def cmd_watch(args):
+    """Live-tail a run directory: new generation/parity/bench records plus
+    a heartbeat liveness verdict (HEALTHY / STALE / DEAD — thresholds at
+    2x / 10x the run's own metric cadence) every ``--interval`` seconds.
+    Exits 0 when the run finishes ok, 1 on error status or a dead run."""
+    from fks_tpu.obs import watch
+
+    try:
+        return watch(args.run_dir, interval=args.interval, once=args.once)
+    except (FileNotFoundError, ValueError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    except KeyboardInterrupt:
+        return 0
+
+
+def cmd_compare(args):
+    """Cross-run regression gate: diff two run dirs (or bench JSONL files)
+    on the shared metric vocabulary — throughput, compile seconds, fitness
+    best/median, parity drift, watchdog violation counts — and exit 1 when
+    the candidate regresses past a threshold (fks_tpu.obs.compare)."""
+    from fks_tpu.obs import compare_runs, format_comparison, has_regression
+    from fks_tpu.obs.compare import parse_threshold_overrides
+
+    try:
+        thresholds = (parse_threshold_overrides(args.threshold)
+                      if args.threshold else None)
+        rows = compare_runs(args.baseline, args.candidate,
+                            thresholds=thresholds)
+    except (FileNotFoundError, ValueError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    print(format_comparison(rows, args.baseline, args.candidate))
+    return 1 if has_regression(rows) else 0
+
+
 def cmd_traces(args):
     """Dataset discovery (reference: parser.py:103-115)."""
     from fks_tpu.data import TraceParser
@@ -555,6 +638,21 @@ def main(argv=None) -> int:
                         "interleave per LLM generation (hybrid mode; the "
                         "champion is rendered to source and competes in "
                         "the code population)")
+    e.add_argument("--watchdog", action="store_true",
+                   help="enable the in-graph numerics watchdog "
+                        "(SimConfig.watchdog): NaN/Inf policy scores are "
+                        "masked to 0 and flagged in "
+                        "SimResult.numeric_flags; violations land as "
+                        "'watchdog' events in the run dir")
+    e.add_argument("--parity-sample", type=int, default=None,
+                   help="per generation, re-score this many sampled "
+                        "population members through the exact reference "
+                        "evaluator (JIT tier) and alert on fitness drift "
+                        "(0 = off; exit 3 when any generation alerts)")
+    e.add_argument("--parity-tol", type=float, default=None,
+                   help="parity drift tolerance (default 1e-5; raise "
+                        "above the measured divergence bound for "
+                        "--engine flat)")
     e.set_defaults(fn=cmd_evolve)
 
     sc = sub.add_parser("scale", help="synthetic scale run + throughput",
@@ -578,6 +676,35 @@ def main(argv=None) -> int:
                        help="summarize a flight-recorder run directory")
     r.add_argument("run_dir", help="directory written by --run-dir")
     r.set_defaults(fn=cmd_report)
+
+    x = sub.add_parser("export-metrics",
+                       help="render a run directory as OpenMetrics text")
+    x.add_argument("run_dir", help="directory written by --run-dir")
+    x.add_argument("--out", default="",
+                   help="write to this file (atomic replace) instead of "
+                        "stdout — point a node_exporter textfile "
+                        "collector at it")
+    x.set_defaults(fn=cmd_export_metrics)
+
+    w = sub.add_parser("watch",
+                       help="live-tail a run directory with a heartbeat "
+                            "liveness verdict")
+    w.add_argument("run_dir", help="directory written by --run-dir")
+    w.add_argument("--interval", type=float, default=5.0,
+                   help="seconds between polls (default 5)")
+    w.add_argument("--once", action="store_true",
+                   help="print one snapshot + verdict and exit")
+    w.set_defaults(fn=cmd_watch)
+
+    c = sub.add_parser("compare",
+                       help="regression-gate a candidate run against a "
+                            "baseline (exit 1 on regression)")
+    c.add_argument("baseline", help="run dir or bench JSONL file")
+    c.add_argument("candidate", help="run dir or bench JSONL file")
+    c.add_argument("--threshold", default="",
+                   help="comma-separated overrides, e.g. "
+                        "'evals_per_sec=rel:0.2,best_score=abs:1e-4'")
+    c.set_defaults(fn=cmd_compare)
 
     t = sub.add_parser("traces", help="list available trace files")
     t.set_defaults(fn=cmd_traces)

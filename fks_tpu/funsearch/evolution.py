@@ -74,6 +74,14 @@ class EvolutionConfig:
     parametric_rounds: int = 0
     parametric_pop: int = 32
     parametric_noise: float = 0.05
+    # parity sentinel (fks_tpu.obs.watchdog.ParitySentinel): re-score this
+    # many sampled population members per generation through the exact
+    # reference evaluator on the JIT tier and alert when |Δfitness|
+    # exceeds parity_tol (0 = off). NOTE: the default tol assumes an
+    # exact-engine search; flat-engine runs need a tol above the trace's
+    # measured divergence bound (tools/divergence_audit.py).
+    parity_sample: int = 0
+    parity_tol: float = 1e-5
 
     llm: LLMSettings = dataclasses.field(default_factory=LLMSettings)
 
@@ -95,6 +103,8 @@ class EvolutionConfig:
             parametric_rounds=fs.get("parametric_rounds", 0),
             parametric_pop=fs.get("parametric_pop", 32),
             parametric_noise=fs.get("parametric_noise", 0.05),
+            parity_sample=fs.get("parity_sample", 0),
+            parity_tol=fs.get("parity_tol", 1e-5),
             llm=LLMSettings(
                 api_key=lm.get("api_key", ""),
                 base_url=lm.get("base_url", LLMSettings.base_url),
@@ -131,6 +141,14 @@ class GenerationStats:
     transpile_failed: int = 0  # syntax / transpile rejection
     rescore_fallbacks: int = 0  # exact rescore failed -> search fitness
     llm_seconds: float = 0.0  # wall time of the LLM candidate stage
+    # numerics watchdog: OR of SimResult.numeric_flags across this
+    # generation's evaluations (0 unless SimConfig.watchdog is on), and
+    # the parity sentinel's per-generation verdict (0 checks unless
+    # EvolutionConfig.parity_sample > 0)
+    watchdog_flags: int = 0
+    parity_checked: int = 0
+    parity_max_drift: float = 0.0
+    parity_alerts: int = 0
 
 
 def _percentile(sorted_desc: Sequence[float], q: float) -> float:
@@ -184,6 +202,11 @@ class FunSearch:
         # under which the ledger performs zero filesystem writes
         self.recorder = recorder if recorder is not None else obs.get_recorder()
         self.ledger = obs.EvolutionLedger(self.recorder, evaluator)
+        # the parity sentinel is a no-op unless parity_sample > 0; its
+        # lifetime ``alerts`` counter feeds the CLI's nonzero-exit policy
+        self.sentinel = obs.ParitySentinel(
+            evaluator, sample=config.parity_sample, tol=config.parity_tol,
+            seed=config.seed, recorder=self.recorder)
         self.rescore_fallbacks = 0  # lifetime count; per-gen delta in stats
         if backend is None:
             if config.llm.api_key:
@@ -378,6 +401,20 @@ class FunSearch:
         eval_s = t.seconds
         sandbox_failed, transpile_failed = _failure_counts(records)
 
+        # numerics watchdog: one event per generation carrying the OR of
+        # every evaluation's flag mask (always 0 when SimConfig.watchdog
+        # is off — the guards are compiled out)
+        wd_flags = 0
+        for r in records:
+            if r.result is not None:
+                wd_flags |= obs.combined_flags(
+                    getattr(r.result, "numeric_flags", 0))
+        if wd_flags:
+            self.recorder.event(
+                "watchdog", flags=wd_flags,
+                kinds=obs.describe_flags(wd_flags),
+                generation=self.generation, candidates=len(records))
+
         accepted = rejected = 0
         for r in records:
             # subprocess-path semantics: failures carry score 0 and still
@@ -399,6 +436,10 @@ class FunSearch:
         self._sort()
         del self.population[cfg.population_size:]
 
+        # parity sentinel: sample the post-truncation population (those
+        # are the members whose fitness selection actually trusts)
+        parity = self.sentinel.check(self.generation, self.population)
+
         scores = [s for _, s in self.population]  # descending post-_sort
         stats = GenerationStats(
             generation=self.generation,
@@ -412,7 +453,11 @@ class FunSearch:
             sandbox_failed=sandbox_failed,
             transpile_failed=transpile_failed,
             rescore_fallbacks=self.rescore_fallbacks - fallbacks0,
-            llm_seconds=llm_s)
+            llm_seconds=llm_s,
+            watchdog_flags=wd_flags,
+            parity_checked=parity["checked"],
+            parity_max_drift=parity["max_drift"],
+            parity_alerts=parity["alerts"])
         self.history.append(stats)
         # ledger first: the flight-recorder trail must be complete even if a
         # user on_generation callback raises

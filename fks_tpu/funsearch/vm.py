@@ -630,6 +630,12 @@ def _execute(prog: VMProgram, pod: PodView, nodes: NodeView,
 
     regs = lax.fori_loop(0, bound, body, regs)
     out = regs[prog.out_reg][:, 0]
+    # Non-finite values (a candidate dividing by zero, log of a negative)
+    # would hit the int cast below with implementation-defined results;
+    # mask them to 0 — the engines' "refuse placement" sentinel — so a
+    # pathological candidate degrades deterministically. Identity for
+    # finite values, which the cast assumes are integral.
+    out = jnp.where(jnp.isfinite(out), out, jnp.zeros_like(out))
     # the policy's jaxpr already ends in an int cast; values are integral
     return out.astype(jnp.int32)
 

@@ -1,5 +1,6 @@
 """fks_tpu.obs — the flight recorder: run directories, spans, compile/
-device telemetry, and the per-generation evolution ledger.
+device telemetry, the per-generation evolution ledger, and the
+watchdog / export / gating layer built on top of them.
 
 Every ROADMAP evidence gap is an observability gap; this package records
 what a run actually did, into a run directory that ``cli report`` renders
@@ -15,7 +16,21 @@ jitted code.
                   mesh/pad-waste snapshots
 - ``ledger``    — per-generation evolution records
 - ``report``    — run-dir summary rendering (``cli report``)
+- ``watchdog``  — numeric guards (re-exported from sim.guards), host
+                  reporting, the online parity sentinel, and the offline
+                  divergence audit (``cli``/tools entry points)
+- ``exporter``  — OpenMetrics text export + heartbeat liveness
+                  (``cli export-metrics`` / ``cli watch``)
+- ``compare``   — cross-run regression gating (``cli compare``,
+                  ``bench.py --gate``)
 """
+from fks_tpu.obs.compare import (
+    DEFAULT_THRESHOLDS, Threshold, compare_runs, extract_metrics,
+    format_comparison, has_regression, parse_threshold_overrides,
+)
+from fks_tpu.obs.exporter import (
+    health_line, run_health, to_openmetrics, watch,
+)
 from fks_tpu.obs.ledger import EvolutionLedger
 from fks_tpu.obs.recorder import (
     NULL, FlightRecorder, NullRecorder, get_recorder, recording,
@@ -26,10 +41,18 @@ from fks_tpu.obs.telemetry import (
     CompileWatcher, device_snapshot, mesh_snapshot, record_devices,
     record_mesh, watch_compiles,
 )
+from fks_tpu.obs.watchdog import (
+    FLAG_INF, FLAG_NAN, FLAG_RANGE, ParitySentinel, check_result,
+    combined_flags, describe_flags,
+)
 
 __all__ = [
-    "NULL", "CompileWatcher", "EvolutionLedger", "FlightRecorder",
-    "NullRecorder", "device_snapshot", "get_recorder", "mesh_snapshot",
-    "record_devices", "record_mesh", "recording", "render_report", "span",
-    "span_path", "sparkline", "watch_compiles",
+    "DEFAULT_THRESHOLDS", "FLAG_INF", "FLAG_NAN", "FLAG_RANGE", "NULL",
+    "CompileWatcher", "EvolutionLedger", "FlightRecorder", "NullRecorder",
+    "ParitySentinel", "Threshold", "check_result", "combined_flags",
+    "compare_runs", "describe_flags", "device_snapshot", "extract_metrics",
+    "format_comparison", "get_recorder", "has_regression", "health_line",
+    "mesh_snapshot", "parse_threshold_overrides", "record_devices",
+    "record_mesh", "recording", "render_report", "run_health", "span",
+    "span_path", "sparkline", "to_openmetrics", "watch", "watch_compiles",
 ]

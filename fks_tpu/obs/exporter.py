@@ -1,0 +1,274 @@
+"""OpenMetrics export + heartbeat liveness for flight-recorder run dirs.
+
+The recorder's JSONL surfaces are append-only and flushed per record, so
+a run directory can be scraped WHILE the run is alive. Two consumers:
+
+- ``to_openmetrics(run_dir)`` renders the run's metrics and event
+  counters as OpenMetrics text exposition (``# TYPE``/``# HELP`` blocks,
+  escaped labels, terminal ``# EOF``) — paste-able into any Prometheus
+  textfile collector or pushgateway without a client library.
+- ``run_health(run_dir)`` classifies liveness from the heartbeat file:
+  a finished run is FINISHED; a live run whose heartbeat is younger than
+  2x its observed cadence is HEALTHY, older is STALE, older than 10x (or
+  no heartbeat at all on an unfinished run) is DEAD. Cadence is the
+  median inter-record gap of the run's own metrics stream — a slow
+  evolution run with 60 s generations is not flagged by a wall-clock
+  constant tuned for fast benches.
+
+``cli export-metrics`` and ``cli watch`` are thin shells over these.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from fks_tpu.obs.report import load_run
+
+#: heartbeat age thresholds, in multiples of the observed cadence
+STALE_FACTOR = 2.0
+DEAD_FACTOR = 10.0
+#: floor for the cadence estimate: sub-second generation gaps would make
+#: any scrape interval look stale
+MIN_CADENCE_SECONDS = 5.0
+
+PREFIX = "fks"
+
+#: (metric suffix, source key, help) for per-generation gauges
+GENERATION_GAUGES = (
+    ("generation_best_score", "best_score", "best fitness in population"),
+    ("generation_median_score", "median_score", "median population fitness"),
+    ("generation_p10_score", "p10_score", "10th-percentile fitness"),
+    ("generation_new_candidates", "new_candidates",
+     "candidates evaluated this generation"),
+    ("generation_accepted", "accepted", "candidates admitted"),
+    ("generation_eval_seconds", "eval_seconds", "evaluation wall seconds"),
+    ("generation_llm_seconds", "llm_seconds", "LLM wall seconds"),
+    ("generation_evals_per_sec", "evals_per_sec", "evaluation throughput"),
+    ("generation_programs_compiled", "programs_compiled",
+     "unique XLA programs built"),
+    ("generation_vm_candidates", "vm_candidates",
+     "candidates served by the VM tier"),
+)
+
+
+def _escape_label(value: Any) -> str:
+    """OpenMetrics label-value escaping: backslash, quote, newline."""
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _labels(**kv: Any) -> str:
+    inner = ",".join(f'{k}="{_escape_label(v)}"'
+                     for k, v in kv.items() if v is not None)
+    return "{" + inner + "}" if inner else ""
+
+
+def _num(v: Any) -> Optional[float]:
+    try:
+        f = float(v)
+    except (TypeError, ValueError):
+        return None
+    return f
+
+
+class _Family:
+    """One metric family: TYPE/HELP header plus its samples."""
+
+    def __init__(self, name: str, mtype: str, help_: str):
+        self.name, self.mtype, self.help = name, mtype, help_
+        self.samples: List[str] = []
+
+    def add(self, value: Any, **labels: Any) -> None:
+        v = _num(value)
+        if v is None:
+            return
+        body = f"{v:.10g}" if v != int(v) else str(int(v))
+        self.samples.append(f"{self.name}{_labels(**labels)} {body}")
+
+    def render(self) -> List[str]:
+        if not self.samples:
+            return []
+        return [f"# TYPE {self.name} {self.mtype}",
+                f"# HELP {self.name} {self.help}"] + self.samples
+
+
+def to_openmetrics(run_dir: str) -> str:
+    """Render a run directory as OpenMetrics text exposition."""
+    meta, events, metrics = load_run(run_dir)
+    run_id = meta.get("run_id", "?")
+    fams: Dict[str, _Family] = {}
+
+    def fam(suffix: str, mtype: str, help_: str) -> _Family:
+        name = f"{PREFIX}_{suffix}"
+        if name not in fams:
+            fams[name] = _Family(name, mtype, help_)
+        return fams[name]
+
+    info = fam("run_info", "gauge",
+               "run identity; value is always 1, identity in labels")
+    info.add(1, run_id=run_id, command=meta.get("command"),
+             status=meta.get("status", "?"))
+    if "wall_seconds" in meta:
+        fam("run_wall_seconds", "gauge", "total run wall time").add(
+            meta["wall_seconds"], run_id=run_id)
+
+    gens = [m for m in metrics if m.get("kind") == "generation"]
+    for g in gens:
+        gen = g.get("generation")
+        for suffix, key, help_ in GENERATION_GAUGES:
+            if key in g:
+                fam(suffix, "gauge", help_).add(
+                    g[key], run_id=run_id, generation=gen)
+    if gens:
+        fam("generations_total", "counter",
+            "generations committed to the ledger").add(
+            len(gens), run_id=run_id)
+
+    for p in (m for m in metrics if m.get("kind") == "parity"):
+        gen = p.get("generation")
+        fam("parity_max_drift", "gauge",
+            "max |fitness drift| vs exact reference this generation").add(
+            p.get("max_drift"), run_id=run_id, generation=gen)
+        fam("parity_checked", "gauge",
+            "candidates parity-checked this generation").add(
+            p.get("checked"), run_id=run_id, generation=gen)
+
+    for s in (m for m in metrics if m.get("kind") == "bench_stage"):
+        stage = s.get("stage", "?")
+        for key in ("evals_per_sec", "code_evals_per_sec", "compile_seconds",
+                    "first_call_seconds", "steady_state_seconds", "value"):
+            if key in s:
+                fam(f"bench_{key}", "gauge",
+                    f"bench stage {key}").add(
+                    s[key], run_id=run_id, stage=stage)
+
+    counts: Dict[str, int] = {}
+    for e in events:
+        kind = e.get("kind", "?")
+        counts[kind] = counts.get(kind, 0) + 1
+    ev = fam("events_total", "counter", "recorder events by kind")
+    for kind in sorted(counts):
+        ev.add(counts[kind], run_id=run_id, kind=kind)
+    wd = fam("watchdog_violations_total", "counter",
+             "watchdog numeric-guard events")
+    wd.add(counts.get("watchdog", 0), run_id=run_id)
+    al = fam("alerts_total", "counter", "alert events (parity drift etc.)")
+    al.add(counts.get("alert", 0), run_id=run_id)
+
+    compile_s = sum(float(e.get("seconds", 0.0)) for e in events
+                    if e.get("kind") == "compile")
+    if compile_s:
+        fam("compile_seconds_total", "counter",
+            "total XLA compile wall seconds").add(compile_s, run_id=run_id)
+
+    health = run_health(run_dir, meta=meta, metrics=metrics)
+    fam("heartbeat_age_seconds", "gauge",
+        "seconds since the last heartbeat (-1: no heartbeat file)").add(
+        health["age"] if health["age"] is not None else -1, run_id=run_id)
+    fam("run_healthy", "gauge",
+        "1 when finished or heartbeat within 2x cadence, else 0").add(
+        1 if health["state"] in ("FINISHED", "HEALTHY") else 0,
+        run_id=run_id)
+
+    lines: List[str] = []
+    for name in sorted(fams):
+        lines.extend(fams[name].render())
+    lines.append("# EOF")
+    return "\n".join(lines) + "\n"
+
+
+def _heartbeat_age(run_dir: str) -> Optional[float]:
+    """Seconds since the run's last heartbeat, None when absent/corrupt."""
+    path = os.path.join(run_dir, "heartbeat")
+    try:
+        with open(path) as f:
+            beat = json.load(f)
+        return max(0.0, time.time() - float(beat["ts"]))
+    except (OSError, ValueError, KeyError, TypeError):
+        return None
+
+
+def _cadence(metrics: List[Dict[str, Any]]) -> float:
+    """Median inter-record gap of the metrics stream (seconds), floored
+    at MIN_CADENCE_SECONDS; the floor alone when under two records."""
+    ts = sorted(float(m["ts"]) for m in metrics if _num(m.get("ts")))
+    gaps = sorted(b - a for a, b in zip(ts, ts[1:]) if b > a)
+    if not gaps:
+        return MIN_CADENCE_SECONDS
+    return max(MIN_CADENCE_SECONDS, gaps[len(gaps) // 2])
+
+
+def run_health(run_dir: str, meta: Optional[dict] = None,
+               metrics: Optional[list] = None) -> Dict[str, Any]:
+    """Liveness verdict for a run dir: ``{"state", "age", "cadence"}``
+    with state one of FINISHED / HEALTHY / STALE / DEAD (see module
+    docstring for the thresholds)."""
+    if meta is None or metrics is None:
+        meta, _events, metrics = load_run(run_dir)
+    age = _heartbeat_age(run_dir)
+    cadence = _cadence(metrics or [])
+    if meta.get("status") in ("ok", "error") or "finished" in meta:
+        return {"state": "FINISHED", "age": age, "cadence": cadence,
+                "status": meta.get("status")}
+    if age is None:
+        return {"state": "DEAD", "age": None, "cadence": cadence,
+                "status": meta.get("status")}
+    if age > DEAD_FACTOR * cadence:
+        state = "DEAD"
+    elif age > STALE_FACTOR * cadence:
+        state = "STALE"
+    else:
+        state = "HEALTHY"
+    return {"state": state, "age": age, "cadence": cadence,
+            "status": meta.get("status")}
+
+
+def health_line(run_dir: str) -> str:
+    """One-line liveness summary, as shown by ``cli watch``/``report``."""
+    h = run_health(run_dir)
+    age = "-" if h["age"] is None else f"{h['age']:.0f}s"
+    return (f"{h['state']}: heartbeat age {age} "
+            f"(cadence ~{h['cadence']:.0f}s)")
+
+
+def watch(run_dir: str, interval: float = 5.0, once: bool = False,
+          out=None, clock=time.sleep) -> int:
+    """Live-tail a run: print the latest generation/bench line plus the
+    liveness verdict every ``interval`` seconds until the run finishes
+    (or forever under an external watchdog). Returns 0 when the run
+    finished ok, 1 when it finished in error or is DEAD."""
+    import sys
+
+    out = out or sys.stdout
+    seen = 0
+    while True:
+        meta, _events, metrics = load_run(run_dir)
+        fresh = metrics[seen:]
+        seen = len(metrics)
+        for m in fresh:
+            kind = m.get("kind")
+            if kind == "generation":
+                out.write(f"gen {m.get('generation')}: "
+                          f"best {m.get('best_score', 0.0):.4f} "
+                          f"new {m.get('new_candidates', 0)} "
+                          f"eval {m.get('eval_seconds', 0.0):.1f}s\n")
+            elif kind == "parity":
+                out.write(f"parity gen {m.get('generation')}: "
+                          f"max drift {m.get('max_drift')}\n")
+            elif kind == "bench_stage":
+                v = m.get("value", m.get("evals_per_sec"))
+                out.write(f"bench {m.get('stage', '?')}: {v}\n")
+        h = run_health(run_dir, meta=meta, metrics=metrics)
+        age = "-" if h["age"] is None else f"{h['age']:.0f}s"
+        out.write(f"[{h['state']}] status={meta.get('status', '?')} "
+                  f"heartbeat {age}\n")
+        out.flush()
+        if h["state"] == "FINISHED":
+            return 0 if meta.get("status") == "ok" else 1
+        if h["state"] == "DEAD":
+            return 1
+        if once:
+            return 0
+        clock(interval)

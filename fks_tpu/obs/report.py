@@ -187,6 +187,16 @@ def render_report(run_dir: str) -> str:
             f" — status {meta.get('status', '?')}")
     if "wall_seconds" in meta:
         head += f", {meta['wall_seconds']}s"
+    # liveness verdict from the heartbeat: a run that claims to be
+    # running but whose heartbeat is older than 2x its own cadence is
+    # STALE, 10x (or heartbeat-less) is DEAD (fks_tpu.obs.exporter)
+    from fks_tpu.obs.exporter import run_health  # deferred: exporter
+    health = run_health(run_dir, meta=meta, metrics=metrics)  # imports us
+    if health["state"] not in ("FINISHED",):
+        age = ("no heartbeat" if health["age"] is None
+               else f"heartbeat {health['age']:.0f}s old")
+        head += (f" — {health['state']} ({age}, "
+                 f"cadence ~{health['cadence']:.0f}s)")
     lines = [head, f"started {meta.get('started', '?')}  dir {run_dir}"]
     for key in ("argv", "best_score", "workload"):
         if key in meta:

@@ -43,6 +43,7 @@ from fks_tpu.ops.heap import (
     first_deletion_in_array_order, heap_from_events, heap_pop, heap_push,
 )
 from fks_tpu.sim.evaluator import max_snapshot_count, snapshot_trigger_table
+from fks_tpu.sim.guards import fitness_flags, sanitize_scores, score_flags
 from fks_tpu.sim.types import NodeView, PodView, PolicyFn, SimResult, SimState
 
 
@@ -74,6 +75,11 @@ class SimConfig:
     # original creation times. The exact engine always tracks (its scatter
     # write is not on the critical path).
     track_ctime: bool = True
+    # numerics watchdog (sim.guards): flag NaN/Inf policy scores into the
+    # carry (masking them to "refuse") and audit the final fitness for
+    # NaN/Inf/out-of-[0,1]. Python-static, so the disabled path compiles
+    # to the exact same program as a build without guards.
+    watchdog: bool = False
 
     def resolve_max_steps(self, num_pods: int) -> int:
         if self.max_steps is not None:
@@ -124,6 +130,7 @@ def initial_state(workload: Workload, cfg: SimConfig) -> SimState:
         failed=jnp.bool_(False),
         steps=jnp.int32(0),
         violations=jnp.int32(0),
+        numeric_flags=jnp.int32(0),
     )
 
 
@@ -232,6 +239,10 @@ def build_step(workload: Workload, policy: PolicyFn, cfg: SimConfig,
                 lambda: jnp.zeros(out.shape, out.dtype))
         else:
             raw_scores = policy(pod_view, node_view)
+        numeric_flags = s.numeric_flags
+        if cfg.watchdog:
+            numeric_flags = numeric_flags | score_flags(raw_scores, create)
+            raw_scores = sanitize_scores(raw_scores)
         scores = jnp.where(c.node_mask, raw_scores, 0)
         b = jnp.argmax(scores).astype(jnp.int32)
         placed = create & (scores[b] > 0)
@@ -341,7 +352,7 @@ def build_step(workload: Workload, policy: PolicyFn, cfg: SimConfig,
             events_processed=events, snap_idx=snap_idx, snap_sums=snap_sums,
             frag_sum=frag_sum, frag_count=frag_count, max_nodes=max_nodes,
             failed=s.failed | alloc_fail, steps=s.steps + active.astype(jnp.int32),
-            violations=violations,
+            violations=violations, numeric_flags=numeric_flags,
         )
 
     return step
@@ -419,6 +430,9 @@ def finalize_fields(workload: Workload, cfg: SimConfig, *, pending, s) -> SimRes
         (n_snap > 0) & all_assigned & ~s.failed & ~truncated, raw,
         jnp.asarray(0, f))
     scheduled = jnp.sum((s.assigned_node >= 0) & pod_mask, dtype=jnp.int32)
+    numeric_flags = s.numeric_flags
+    if cfg.watchdog:
+        numeric_flags = numeric_flags | fitness_flags(score)
     return SimResult(
         policy_score=score,
         avg_cpu_utilization=avg[0], avg_memory_utilization=avg[1],
@@ -430,7 +444,7 @@ def finalize_fields(workload: Workload, cfg: SimConfig, *, pending, s) -> SimRes
         assigned_gpus=s.assigned_gpus, pod_ctime=s.pod_ctime,
         cpu_left=s.cpu_left, mem_left=s.mem_left, gpu_left=s.gpu_left,
         gpu_milli_left=s.gpu_milli_left, failed=s.failed, truncated=truncated,
-        invariant_violations=s.violations,
+        invariant_violations=s.violations, numeric_flags=numeric_flags,
     )
 
 

@@ -80,6 +80,7 @@ from fks_tpu.sim.engine import (
     SimConfig, _audit, _node_view, _widest_int, finalize_fields,
     loop_tables, run_batched_lanes,
 )
+from fks_tpu.sim.guards import sanitize_scores, score_flags
 from fks_tpu.sim.types import FlatState, PodView, PolicyFn, SimResult
 
 INF = jnp.iinfo(jnp.int32).max  # empty-slot sentinel
@@ -148,6 +149,7 @@ def initial_state(workload: Workload, cfg: SimConfig) -> FlatState:
         failed=jnp.bool_(False),
         steps=jnp.int32(0),
         violations=jnp.int32(0),
+        numeric_flags=jnp.int32(0),
     )
 
 
@@ -251,6 +253,10 @@ def build_step(workload: Workload, policy: PolicyFn, cfg: SimConfig,
                 lambda: jnp.zeros(out.shape, out.dtype))
         else:
             raw_scores = policy(pod_view, node_view)
+        numeric_flags = s.numeric_flags
+        if cfg.watchdog:
+            numeric_flags = numeric_flags | score_flags(raw_scores, create)
+            raw_scores = sanitize_scores(raw_scores)
         scores = jnp.where(c.node_mask, raw_scores, 0)
         w = jnp.argmax(scores).astype(jnp.int32)
         placed = create & (scores[w] > 0)
@@ -356,6 +362,7 @@ def build_step(workload: Workload, policy: PolicyFn, cfg: SimConfig,
             snap_sums=snap_sums, frag_sum=frag_sum, frag_count=frag_count,
             max_nodes=max_nodes, failed=s.failed | alloc_fail,
             steps=s.steps + active.astype(jnp.int32), violations=violations,
+            numeric_flags=numeric_flags,
         )
 
     return step
@@ -394,6 +401,7 @@ class _FinalView(NamedTuple):
     max_nodes: Any
     failed: Any
     violations: Any
+    numeric_flags: Any
 
 
 def finalize(workload: Workload, cfg: SimConfig, s: FlatState) -> SimResult:
@@ -410,6 +418,7 @@ def finalize(workload: Workload, cfg: SimConfig, s: FlatState) -> SimResult:
         events_processed=s.events_processed, snap_idx=s.snap_idx,
         snap_sums=s.snap_sums, frag_sum=s.frag_sum, frag_count=s.frag_count,
         max_nodes=s.max_nodes, failed=s.failed, violations=s.violations,
+        numeric_flags=s.numeric_flags,
     )
     return finalize_fields(workload, cfg, pending=s.pending > 0, s=view)
 

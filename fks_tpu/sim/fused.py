@@ -524,6 +524,7 @@ def make_fused_population_run(workload: Workload,
             snap_sums=accf[:pop, 0:4], frag_sum=accf[:pop, 4],
             frag_count=acci[:pop, 4], max_nodes=acci[:pop, 5],
             failed=acci[:pop, 6] > 0, violations=jnp.zeros(pop, jnp.int32),
+            numeric_flags=jnp.zeros(pop, jnp.int32),
         )
         return jax.vmap(
             lambda v, pend: finalize_fields(workload, cfg, pending=pend, s=v)

@@ -86,6 +86,7 @@ class SimState(NamedTuple):
     failed: Any  # bool: GPU allocation raised in the reference -> abort
     steps: Any  # i32
     violations: Any  # i32: invariant-audit failures (0 unless enabled)
+    numeric_flags: Any  # i32 watchdog bitmask (0 unless SimConfig.watchdog)
 
     # pod_state column indices
     COL_NODE = 0
@@ -142,6 +143,7 @@ class FlatState(NamedTuple):
     failed: Any
     steps: Any
     violations: Any
+    numeric_flags: Any  # i32 watchdog bitmask (0 unless SimConfig.watchdog)
 
 
 class SimResult(NamedTuple):
@@ -169,3 +171,6 @@ class SimResult(NamedTuple):
     failed: Any  # bool
     truncated: Any  # bool: hit max_steps with events remaining
     invariant_violations: Any  # i32 (0 unless validate_invariants)
+    # i32 watchdog bitmask (sim.guards.FLAG_*; 0 unless SimConfig.watchdog):
+    # sticky OR of per-step policy-score violations + final fitness check
+    numeric_flags: Any

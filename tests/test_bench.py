@@ -122,3 +122,58 @@ def test_fallback_json_without_any_bank(banked_repo):
     assert payload["value"] == 0.0
     assert "banked_from" not in payload
     assert "no recorded" in payload["note"]
+
+
+def test_classify_probe_failure_taxonomy():
+    """The four structured probe-failure kinds (round 7): a timeout, a
+    signal death, an import failure, and a plain init failure are told
+    apart instead of collapsing into one error string."""
+    import signal as _signal
+
+    assert bench._classify_probe_failure(None, "")[0] == "timeout"
+    kind, detail = bench._classify_probe_failure(-_signal.SIGILL, "")
+    assert kind == "sigill-risk" and "SIGILL" in detail
+    kind, _ = bench._classify_probe_failure(-9999, "")  # unknown signal
+    assert kind == "sigill-risk"
+    kind, _ = bench._classify_probe_failure(
+        1, "Traceback...\nModuleNotFoundError: no module named jax")
+    assert kind == "import-error"
+    kind, detail = bench._classify_probe_failure(1, "RuntimeError: boom")
+    assert kind == "init-failure" and "rc=1" in detail
+
+
+def test_fallback_json_carries_failure_taxonomy(banked_repo):
+    """The taxonomy rides along in the fallback payload while the headline
+    stays the honest 0.0 + banked_from shape."""
+    _write_round(banked_repo, 6, [
+        {"ok": True, "stage": "flatseed", "ts": 2,
+         "result": {"evals_per_sec": 321.0}},
+    ])
+    attempts = [
+        {"attempt": 1, "kind": "timeout",
+         "detail": "device backend initialization timed out"},
+        {"attempt": 2, "kind": "timeout",
+         "detail": "device backend initialization timed out"},
+        {"attempt": 3, "kind": "init-failure",
+         "detail": "backend initialization failed (rc=1)"},
+    ]
+    payload = json.loads(bench._fallback_json("probe failed",
+                                              failure_taxonomy=attempts))
+    assert payload["value"] == 0.0 and payload["vs_baseline"] == 0.0
+    assert payload["banked_from"]["value"] == 321.0
+    assert payload["failure_taxonomy"]["kinds"] == {
+        "timeout": 2, "init-failure": 1}
+    assert payload["failure_taxonomy"]["attempts"] == attempts
+
+
+def test_gate_judges_headline_against_baseline(tmp_path, capsys):
+    baseline = tmp_path / "baseline.jsonl"
+    baseline.write_text(json.dumps(
+        {"value": 100.0, "unit": "evals/s"}) + "\n")
+    ok = bench._gate(str(baseline), {"value": 95.0, "unit": "evals/s"})
+    reg = bench._gate(str(baseline), {"value": 70.0, "unit": "evals/s"})
+    err = capsys.readouterr().err
+    assert ok == 0 and reg == 1
+    assert "REGRESSION" in err
+    # a broken gate (missing baseline) fails closed without raising
+    assert bench._gate(str(tmp_path / "nope.jsonl"), {"value": 1.0}) == 1

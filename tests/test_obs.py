@@ -380,7 +380,8 @@ def test_check_jsonl_schema_tool(tmp_path):
 
     with obs.FlightRecorder(str(tmp_path / "run")) as rec:
         rec.event("span", label="x", seconds=0.0)
-        rec.metric("generation", {"generation": 1})
+        # known kinds must carry their required keys (watchdog schema)
+        rec.metric("generation", {"generation": 1, "best_score": 0.5})
     counts = cjs.check_run_dir(str(tmp_path / "run"))
     assert counts["events.jsonl"] == 1
     assert counts["metrics.jsonl"] == 1

@@ -10,11 +10,23 @@ Two jobs, one helper:
   directory: ``meta.json`` (run_id/started/status), ``events.jsonl`` and
   ``metrics.jsonl`` (ts/kind per line), ``heartbeat`` when present.
 
+Beyond the generic ts/kind floor, records of KNOWN kinds (the watchdog /
+alert / parity / probe_failure vocabulary added with the numerics
+watchdog, plus the evolution ledger's generation records) are checked
+for their kind-specific required keys — a watchdog event without a flag
+mask is as corrupt as a line without a timestamp.
+
+``check_openmetrics(text)`` validates the ``cli export-metrics`` output:
+every exposition line is a comment, a ``# TYPE``/``# HELP`` header, or a
+``name{labels} value`` sample whose family was declared first, and the
+exposition ends with ``# EOF``.
+
 Usage:
     python tools/check_jsonl_schema.py --run-dir runs/evolve1
+    python tools/check_jsonl_schema.py --openmetrics metrics.prom
     python tools/check_jsonl_schema.py benchmarks/results/round*_tpu.jsonl
 
-The second form checks arbitrary JSONL evidence files (the TPU session
+The last form checks arbitrary JSONL evidence files (the TPU session
 logs under benchmarks/results/ predate the recorder and have no fixed
 keys, so they are checked for parseability only unless --require is
 given). Exit code 0 = clean, 1 = violations (printed one per line).
@@ -24,6 +36,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import re
 import sys
 from typing import Dict, List, Sequence, Tuple
 
@@ -34,6 +47,27 @@ RUN_DIR_REQUIRED: Dict[str, Tuple[str, ...]] = {
 }
 #: required keys in a run dir's meta.json
 META_REQUIRED: Tuple[str, ...] = ("run_id", "started", "status")
+
+#: kind-specific required keys, per surface. Unknown kinds pass (the
+#: recorder is an open vocabulary); known kinds must be well-formed.
+EVENT_KIND_REQUIRED: Dict[str, Tuple[str, ...]] = {
+    "watchdog": ("flags", "kinds"),
+    "alert": ("source",),
+    "probe_failure": ("attempt",),
+    "span": ("seconds",),
+    "compile": ("seconds",),
+}
+METRIC_KIND_REQUIRED: Dict[str, Tuple[str, ...]] = {
+    "generation": ("generation", "best_score"),
+    "parity": ("generation", "checked", "max_drift"),
+}
+
+#: an OpenMetrics sample line: name, optional {labels}, value, optional ts
+_SAMPLE_RE = re.compile(
+    r'^[a-zA-Z_:][a-zA-Z0-9_:]*'                 # metric name
+    r'(\{[a-zA-Z_][a-zA-Z0-9_]*="(\\.|[^"\\])*"'  # first label
+    r'(,[a-zA-Z_][a-zA-Z0-9_]*="(\\.|[^"\\])*")*\})?'  # more labels
+    r' -?[0-9.eE+-]+( [0-9.eE+-]+)?$')
 
 
 class SchemaError(ValueError):
@@ -77,6 +111,59 @@ def check_jsonl(path: str, required: Sequence[str] = (),
     return records
 
 
+def check_kinds(path: str, records: List[dict],
+                kind_required: Dict[str, Tuple[str, ...]]) -> None:
+    """Per-kind key validation over parsed records: every record whose
+    ``kind`` is in the known vocabulary must carry that kind's required
+    keys. Raises ``SchemaError`` naming the record index."""
+    for i, rec in enumerate(records):
+        required = kind_required.get(rec.get("kind", ""))
+        if not required:
+            continue
+        missing = [k for k in required if k not in rec]
+        if missing:
+            raise SchemaError(
+                f"{path}: record {i + 1} (kind={rec.get('kind')!r}): "
+                f"missing {missing}")
+
+
+def check_openmetrics(text: str, path: str = "<openmetrics>") -> int:
+    """Validate OpenMetrics text exposition (``cli export-metrics``):
+    declared-before-sampled families, well-formed sample lines, terminal
+    ``# EOF``. Returns the sample count; raises ``SchemaError``."""
+    lines = text.splitlines()
+    stripped = [ln for ln in lines if ln.strip()]
+    if not stripped or stripped[-1] != "# EOF":
+        raise SchemaError(f"{path}: missing terminal '# EOF'")
+    declared = set()
+    samples = 0
+    for i, line in enumerate(lines, 1):
+        if not line.strip() or line == "# EOF":
+            continue
+        if line.startswith("# TYPE ") or line.startswith("# HELP "):
+            parts = line.split(None, 3)
+            if len(parts) < 4:
+                raise SchemaError(f"{path}:{i}: malformed header {line!r}")
+            declared.add(parts[2])
+            continue
+        if line.startswith("#"):
+            continue  # free-form comment
+        if not _SAMPLE_RE.match(line):
+            raise SchemaError(f"{path}:{i}: malformed sample {line!r}")
+        name = re.split(r"[{ ]", line, 1)[0]
+        # suffixed samples (_total, _bucket, ...) belong to the base family
+        base = {name} | {name[: -len(sfx)]
+                         for sfx in ("_total", "_sum", "_count", "_bucket")
+                         if name.endswith(sfx)}
+        if not (base & declared):
+            raise SchemaError(f"{path}:{i}: sample for undeclared family "
+                              f"{name!r} (no preceding # TYPE)")
+        samples += 1
+    if samples == 0:
+        raise SchemaError(f"{path}: no samples")
+    return samples
+
+
 def check_run_dir(run_dir: str) -> Dict[str, int]:
     """Validate a FlightRecorder run directory; returns per-file record
     counts. Raises ``SchemaError`` on the first violation."""
@@ -97,7 +184,11 @@ def check_run_dir(run_dir: str) -> Dict[str, int]:
         if not os.path.exists(path):
             counts[name] = 0  # a run may legitimately record no metrics
             continue
-        counts[name] = len(check_jsonl(path, required=required))
+        records = check_jsonl(path, required=required)
+        check_kinds(path, records,
+                    EVENT_KIND_REQUIRED if name == "events.jsonl"
+                    else METRIC_KIND_REQUIRED)
+        counts[name] = len(records)
     hb = os.path.join(run_dir, "heartbeat")
     if os.path.exists(hb):
         try:
@@ -120,9 +211,12 @@ def main(argv=None) -> int:
                     help="validate a flight-recorder run directory instead")
     ap.add_argument("--require", default="",
                     help="comma-separated keys every record must carry")
+    ap.add_argument("--openmetrics", default="",
+                    help="validate an OpenMetrics text file "
+                         "(cli export-metrics output)")
     args = ap.parse_args(argv)
-    if not args.run_dir and not args.paths:
-        ap.error("give JSONL paths or --run-dir")
+    if not args.run_dir and not args.paths and not args.openmetrics:
+        ap.error("give JSONL paths, --run-dir, or --openmetrics")
     required = [k for k in args.require.split(",") if k]
     rc = 0
     if args.run_dir:
@@ -130,6 +224,18 @@ def main(argv=None) -> int:
             counts = check_run_dir(args.run_dir)
             print(f"{args.run_dir}: ok "
                   + " ".join(f"{k}={v}" for k, v in sorted(counts.items())))
+        except SchemaError as e:
+            print(f"SCHEMA: {e}", file=sys.stderr)
+            rc = 1
+    if args.openmetrics:
+        try:
+            with open(args.openmetrics) as f:
+                n = check_openmetrics(f.read(), args.openmetrics)
+            print(f"{args.openmetrics}: ok ({n} samples)")
+        except OSError as e:
+            print(f"SCHEMA: {args.openmetrics}: unreadable ({e})",
+                  file=sys.stderr)
+            rc = 1
         except SchemaError as e:
             print(f"SCHEMA: {e}", file=sys.stderr)
             rc = 1

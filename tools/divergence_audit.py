@@ -1,169 +1,29 @@
 """Per-trace flat-vs-exact fitness divergence audit (round-3 verdict
-weak #3).
+weak #3) — thin entry point.
 
-The flat engine's documented retry-time rule divergence (fks_tpu/sim/
-flat.py module docstring) was previously summarized by ONE global number
-(|d| <= 0.029 on published policies, default trace). Search selection on a
-retry-heavy trace needs a bound measured on THAT trace, so this tool runs
-a panel of real candidate sources — the two seed policies plus the top
-discovered champions (the policies flat-engine selection actually ranks) —
-through BOTH engines on EVERY shipped pod trace and records the per-trace
-max |score_flat - score_exact| at search precision (f32).
+The divergence engine itself lives in ``fks_tpu.obs.watchdog``
+(``panel_sources``/``audit_trace``/``run_audit``), shared with the
+online parity sentinel so there is exactly ONE place that defines what
+"engine drift" means. This wrapper keeps the historical invocation:
 
-One engine compile per (engine, trace): the panel rides the VM tier
-(policies as data through a single compiled interpreter program), so the
-audit costs 2 compiles per trace, not 2 x |panel|.
+    python tools/divergence_audit.py [--out F] [--traces a.csv,b.csv]
+                                     [--top-champions K] [--cpu]
 
 Output: one JSONL row per trace to --out (default
-benchmarks/results/divergence_audit.jsonl) and a summary table on stdout.
-The evolve CLI reads the latest audit to warn when `--engine flat` is
-selected on a trace whose measured bound exceeds the champion gap.
+benchmarks/results/divergence_audit.jsonl) and a summary table on
+stdout. The evolve CLI reads the latest audit to warn when
+``--engine flat`` is selected on a trace whose measured bound exceeds
+the champion gap.
 """
 from __future__ import annotations
 
-import argparse
-import glob
-import json
 import os
 import sys
-import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
-
-def panel_sources(top_k: int = 3):
-    """Seed policies + the top-k discovered champion sources by score."""
-    from fks_tpu.funsearch import template
-
-    sources = dict(template.seed_policies())
-    champs = []
-    for path in glob.glob(os.path.join(REPO, "policies", "discovered",
-                                       "funsearch_*_score*.json")):
-        try:
-            with open(path) as f:
-                doc = json.load(f)
-            champs.append((float(doc["score"]), os.path.basename(path),
-                           doc["code"]))
-        except (KeyError, ValueError, OSError, json.JSONDecodeError):
-            continue  # skip-and-continue: one bad file must not end it
-    champs.sort(reverse=True)
-    for score, name, code in champs[:top_k]:
-        sources[f"champion_{score:.4f}"] = code
-    return sources
-
-
-def audit_trace(pod_file: str, sources, cfg_kw) -> dict:
-    import jax
-
-    from fks_tpu.data import TraceParser
-    from fks_tpu.funsearch import vm
-    from fks_tpu.sim import flat
-    from fks_tpu.sim import engine as exact
-    from fks_tpu.sim.engine import SimConfig
-
-    wl = TraceParser().parse_workload(pod_file=pod_file)
-    n, g = wl.cluster.n_padded, wl.cluster.g_padded
-    cfg = SimConfig(cond_policy=True, **cfg_kw)
-    runs = {
-        "exact": (jax.jit(exact.make_param_run_fn(wl, vm.score, cfg)),
-                  exact.initial_state(wl, cfg)),
-        "flat": (jax.jit(flat.make_param_run_fn(wl, vm.score, cfg)),
-                 flat.initial_state(wl, cfg)),
-    }
-    per_policy = {}
-    events = scheduled = 0
-    for name, code in sources.items():
-        try:
-            prog = vm.compile_policy(code, n, g, capacity=512)
-        except Exception as e:  # noqa: BLE001 — skip, keep the audit going
-            per_policy[name] = {"skipped": f"{type(e).__name__}"}
-            continue
-        scores, trunc, ev = {}, {}, {}
-        for eng, (run, s0) in runs.items():
-            res = run(prog, s0)
-            scores[eng] = float(res.policy_score)
-            trunc[eng] = bool(res.truncated) or bool(res.failed)
-            ev[eng] = int(res.events_processed)
-            if eng == "exact":
-                events = max(events, ev[eng])
-                scheduled = max(scheduled, int(res.scheduled_pods))
-        per_policy[name] = {
-            "exact": round(scores["exact"], 6),
-            "flat": round(scores["flat"], 6),
-            "flat_events": ev["flat"],  # cascade magnitude is visible here
-            "abs_d": round(abs(scores["exact"] - scores["flat"]), 6),
-            # truncated-on-flat-only marks a RETRY CASCADE: the flat
-            # retry-time rule re-queues enough extra creations to blow the
-            # event budget, zeroing the score. Distinct from arithmetic
-            # drift — conservative for search (the candidate is culled,
-            # never over-promoted), but it under-ranks a true champion.
-            "flat_cascade": trunc["flat"] and not trunc["exact"],
-        }
-    ds = [p["abs_d"] for p in per_policy.values() if "abs_d" in p]
-    drift = [p["abs_d"] for p in per_policy.values()
-             if "abs_d" in p and not p["flat_cascade"]]
-    return {
-        "trace": pod_file, "num_pods": wl.num_pods,
-        "num_nodes": wl.num_nodes,
-        "max_events_processed": events, "max_scheduled": scheduled,
-        "max_abs_d": max(ds) if ds else None,
-        "mean_abs_d": round(sum(ds) / len(ds), 6) if ds else None,
-        "max_drift": max(drift) if drift else None,  # cascades excluded
-        "flat_cascades": sum(p.get("flat_cascade", False)
-                             for p in per_policy.values()),
-        "policies": per_policy,
-    }
-
-
-def main():
-    ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("--out", default=os.path.join(
-        REPO, "benchmarks", "results", "divergence_audit.jsonl"))
-    ap.add_argument("--traces", default="",
-                    help="comma-separated pod CSVs (default: all)")
-    ap.add_argument("--top-champions", type=int, default=3)
-    ap.add_argument("--cpu", action="store_true")
-    args = ap.parse_args()
-
-    import jax
-    if args.cpu:
-        jax.config.update("jax_platforms", "cpu")
-
-    from fks_tpu.data import TraceParser
-
-    traces = (args.traces.split(",") if args.traces
-              else TraceParser().get_available_pod_files())
-    sources = panel_sources(args.top_champions)
-    print(f"panel: {list(sources)}", file=sys.stderr)
-    os.makedirs(os.path.dirname(args.out), exist_ok=True)
-    rows = []
-    for pod_file in traces:
-        t0 = time.time()
-        try:
-            row = audit_trace(pod_file, sources, {})
-        except Exception as e:  # noqa: BLE001 — a bad trace must not end
-            row = {"trace": pod_file, "error": f"{type(e).__name__}: {e}"}
-        row["wall_s"] = round(time.time() - t0, 1)
-        rows.append(row)
-        with open(args.out, "a") as f:
-            f.write(json.dumps({"ts": round(time.time(), 1), **row}) + "\n")
-        print(f"{pod_file}: max|d|={row.get('max_abs_d')} "
-              f"({row['wall_s']}s)", file=sys.stderr)
-
-    width = max(len(r["trace"]) for r in rows)
-    print(f"{'trace':<{width}}  {'pods':>6}  {'events':>7}  "
-          f"{'max|d|':>8}  {'drift':>8}  {'cascades':>8}")
-    for r in sorted(rows, key=lambda r: -(r.get("max_abs_d") or 0)):
-        if "error" in r:
-            print(f"{r['trace']:<{width}}  ERROR {r['error']}")
-        else:
-            print(f"{r['trace']:<{width}}  {r['num_pods']:>6}  "
-                  f"{r['max_events_processed']:>7}  "
-                  f"{r['max_abs_d']:>8}  {r['max_drift']:>8}  "
-                  f"{r['flat_cascades']:>8}")
-    return 0
-
+from fks_tpu.obs.watchdog import audit_main  # noqa: E402
 
 if __name__ == "__main__":
-    sys.exit(main())
+    sys.exit(audit_main())

@@ -2,6 +2,13 @@
 to benchmarks/results/full_suite.jsonl — the per-round CI stand-in the
 README's "CI story for the slow tier" section points at. One row per run:
 pass/fail/deselected counts, wall time, git revision.
+
+Before pytest, an OBSERVABILITY GATE runs against the golden run-dir
+fixture (tests/fixtures/golden_run): the JSONL schema checker must pass
+it, and ``cli compare`` of the fixture against itself must exit 0 — the
+two tools CI leans on must agree that a known-good run dir is good
+before their verdicts on real runs mean anything. A gate failure is
+recorded in the evidence row (``obs_gate``) and fails the suite run.
 """
 from __future__ import annotations
 
@@ -14,12 +21,35 @@ import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 OUT = os.path.join(REPO, "benchmarks", "results", "full_suite.jsonl")
+GOLDEN = os.path.join(REPO, "tests", "fixtures", "golden_run")
+
+
+def obs_gate() -> dict:
+    """Schema-check the golden run dir and self-compare it (exit 0
+    expected). Returns {"ok": bool, "detail": ...}."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    schema = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "check_jsonl_schema.py"),
+         "--run-dir", GOLDEN],
+        capture_output=True, text=True, cwd=REPO)
+    compare = subprocess.run(
+        [sys.executable, "-m", "fks_tpu.cli", "compare", GOLDEN, GOLDEN],
+        capture_output=True, text=True, cwd=REPO, env=env)
+    ok = schema.returncode == 0 and compare.returncode == 0
+    detail = {"schema_rc": schema.returncode, "compare_rc": compare.returncode}
+    if not ok:
+        detail["schema_err"] = (schema.stderr or "")[-500:]
+        detail["compare_err"] = (compare.stderr or compare.stdout or "")[-500:]
+    return {"ok": ok, **detail}
 
 
 def main() -> int:
     rev = subprocess.run(["git", "rev-parse", "--short", "HEAD"],
                          capture_output=True, text=True, cwd=REPO
                          ).stdout.strip()
+    gate = obs_gate()
+    if not gate["ok"]:
+        print(f"OBS GATE FAILED: {gate}", file=sys.stderr)
     t0 = time.time()
     proc = subprocess.run(
         [sys.executable, "-m", "pytest", "tests/", "-q",
@@ -30,14 +60,15 @@ def main() -> int:
     summary = tail[0] if tail else ""
     counts = {k: int(v) for v, k in re.findall(
         r"(\d+) (passed|failed|error|skipped|deselected|xfailed)", summary)}
-    row = {"ts": round(time.time(), 1), "rev": rev, "rc": proc.returncode,
-           "wall_s": wall, **counts, "summary": summary}
+    row = {"ts": round(time.time(), 1), "rev": rev,
+           "rc": proc.returncode if gate["ok"] else (proc.returncode or 1),
+           "wall_s": wall, **counts, "obs_gate": gate, "summary": summary}
     os.makedirs(os.path.dirname(OUT), exist_ok=True)
     with open(OUT, "a") as f:
         f.write(json.dumps(row) + "\n")
     print(json.dumps(row))
     sys.stderr.write((proc.stdout or "")[-2000:])
-    return proc.returncode
+    return proc.returncode if gate["ok"] else (proc.returncode or 1)
 
 
 if __name__ == "__main__":
