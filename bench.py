@@ -380,6 +380,32 @@ def _probe_backend(budget_s: int):
 # ---------------------------------------------------------------- stages
 
 
+def _cost_estimates(fn, *args) -> dict:
+    """XLA's static cost model for the jitted ``fn`` at these args:
+    {"cost_flops": ..., "cost_bytes_accessed": ...}. AOT-only (lower →
+    compile → cost_analysis), so it reuses the already-compiled program
+    and costs no extra device time. Anything missing — a host-loop
+    wrapper with no ``.lower``, a backend that doesn't publish the
+    analysis — degrades to {} with a log line, never an error."""
+    try:
+        cost = fn.lower(*args).compile().cost_analysis()
+    except Exception as e:  # noqa: BLE001 — estimates are best-effort
+        log(f"cost_analysis unavailable: {type(e).__name__}: {e}")
+        return {}
+    # older jax returns a list of per-program dicts, newer a single dict
+    if isinstance(cost, (list, tuple)):
+        cost = cost[0] if cost else {}
+    if not isinstance(cost, dict):
+        return {}
+    out = {}
+    for key, name in (("flops", "cost_flops"),
+                      ("bytes accessed", "cost_bytes_accessed")):
+        v = cost.get(key)
+        if v is not None:
+            out[name] = float(v)
+    return out
+
+
 def stage_parity(engine: str) -> int:
     """CPU subprocess: exact-engine parity gate + flat-engine sanity."""
     import jax
@@ -506,6 +532,8 @@ def stage_throughput(pop: int, chunk: int, reps: int, engine: str) -> int:
         "backend_compiles": watcher.backend_compile_count,
         "first_call_seconds": round(t_compile, 3),
         "steady_state_seconds": round(best, 3),
+        # static per-chunk XLA cost (flops / bytes) for the compiled eval
+        **_cost_estimates(ev, batches[0]),
     }))
     return 0
 
@@ -580,12 +608,20 @@ def stage_codetput() -> int:
     log(f"steady-state: {best:.3f}s / {pop} code evals "
         f"(truncated {n_trunc}/{pop}); XLA backend compile "
         f"{watcher.backend_compile_seconds:.1f}s")
+    if len(devices) > 1:
+        padded, real = pad_population(batch, mesh)
+        cost = _cost_estimates(sharded, padded, real)
+    else:
+        # seg is a segmented HOST loop, not a jitted callable — the
+        # helper logs "no .lower" and returns {}
+        cost = _cost_estimates(seg, batch, state0)
     print(json.dumps({
         "code_evals_per_sec": pop / best, "mode": mode,
         "compile_seconds": round(watcher.backend_compile_seconds, 3),
         "backend_compiles": watcher.backend_compile_count,
         "first_call_seconds": round(first_call, 3),
         "steady_state_seconds": round(best, 3),
+        **cost,
     }))
     return 0
 
@@ -797,7 +833,7 @@ def main():
     # compile-vs-steady-state split from the winning throughput stage
     # (PAPERS.md: evosax/Fast PBRL report the two separately; so do we)
     for k in ("compile_seconds", "backend_compiles", "first_call_seconds",
-              "steady_state_seconds"):
+              "steady_state_seconds", "cost_flops", "cost_bytes_accessed"):
         if k in stage_res:
             payload[k] = stage_res[k]
     if code_eps is not None:

@@ -17,6 +17,7 @@ Usage:
     python -m fks_tpu.cli export-metrics RUN_DIR [--out F]
     python -m fks_tpu.cli watch RUN_DIR [--interval S] [--once]
     python -m fks_tpu.cli compare BASELINE CANDIDATE [--threshold m=rel:X]
+    python -m fks_tpu.cli trace-diff --engines exact,flat [--policy P | --code F]
     python -m fks_tpu.cli traces
 
 Every subcommand accepts ``--run-dir DIR`` to flight-record the run
@@ -573,6 +574,59 @@ def cmd_compare(args):
     return 1 if has_regression(rows) else 0
 
 
+def cmd_trace_diff(args):
+    """Replay one policy through two engines with the decision trace on and
+    report the first divergent scheduling step (fks_tpu.obs.tracing).
+    Exit code contract: 0 = no divergence, 1 = divergence found, 2 = error
+    — scriptable like ``compare`` (tools/run_full_suite.py's trace gate
+    leans on the 0 path)."""
+    _apply_platform_flags(args)
+    from fks_tpu.obs import tracing
+    from fks_tpu.sim.engine import SimConfig
+
+    engines = [e.strip() for e in args.engines.split(",") if e.strip()]
+    if len(engines) != 2:
+        print(f"--engines needs exactly two comma-separated names, got "
+              f"{engines}", file=sys.stderr)
+        return 2
+    bad = [e for e in engines if e not in ("exact", "flat")]
+    if bad:
+        print(f"unsupported trace engine(s) {bad}: the fused kernel does "
+              "not carry the decision trace; use 'exact' and/or 'flat'",
+              file=sys.stderr)
+        return 2
+    _, wl = _parse_workload(args)
+    code = ""
+    if args.code:
+        try:
+            with open(args.code) as f:
+                code = f.read()
+        except OSError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+    try:
+        param_policy, params = tracing.policy_params(
+            wl, policy_name=args.policy, code=code)
+    except Exception as e:  # noqa: BLE001 — bad policy/code is a usage error
+        print(f"error: {type(e).__name__}: {e}", file=sys.stderr)
+        return 2
+    cfg_kw = {"cond_policy": True}
+    if args.max_steps:
+        cfg_kw["max_steps"] = args.max_steps
+    # duplicate engine names (exact-vs-exact self-consistency) get #i tags
+    # so the record's per-engine keys stay distinct
+    names = [f"{e}#{i}" if engines.count(e) > 1 else e
+             for i, e in enumerate(engines)]
+    specs = [(name, eng, param_policy, params)
+             for name, eng in zip(names, engines)]
+    with _flight_recorder(args, "trace-diff") as rec:
+        record = tracing.trace_diff(
+            wl, specs, cfg=SimConfig(**cfg_kw), score_tol=args.tol,
+            recorder=rec, label=(args.code or args.policy))
+    print(tracing.format_diff(record))
+    return 1 if record["divergent"] else 0
+
+
 def cmd_traces(args):
     """Dataset discovery (reference: parser.py:103-115)."""
     from fks_tpu.data import TraceParser
@@ -705,6 +759,31 @@ def main(argv=None) -> int:
                    help="comma-separated overrides, e.g. "
                         "'evals_per_sec=rel:0.2,best_score=abs:1e-4'")
     c.set_defaults(fn=cmd_compare)
+
+    td = sub.add_parser(
+        "trace-diff",
+        help="replay one policy through two engines with decision traces "
+             "and report the first divergent step (exit 1 on divergence)")
+    _add_trace_flags(td)
+    td.add_argument("--engines", default="exact,flat",
+                    help="two comma-separated engines from {exact, flat} "
+                         "(the fused kernel cannot carry the trace); "
+                         "repeat one (exact,exact) for a self-check")
+    td.add_argument("--policy", default="best_fit",
+                    help="zoo policy to replay (ignored with --code)")
+    td.add_argument("--code", default="",
+                    help="candidate source file to replay on the "
+                         "funsearch VM instead of a zoo policy")
+    td.add_argument("--max-steps", type=int, default=0,
+                    help="cap replay steps (0 = engine default)")
+    td.add_argument("--tol", type=float, default=1e-5,
+                    help="score/margin comparison tolerance (default 1e-5)")
+    td.add_argument("--cpu", action="store_true",
+                    help="force the CPU backend (skip the TPU tunnel)")
+    td.add_argument("--run-dir", default="",
+                    help="flight-recorder run directory for the "
+                         "decision_trace / trace_diff records")
+    td.set_defaults(fn=cmd_trace_diff)
 
     t = sub.add_parser("traces", help="list available trace files")
     t.set_defaults(fn=cmd_traces)

@@ -19,6 +19,8 @@ jitted code.
 - ``watchdog``  — numeric guards (re-exported from sim.guards), host
                   reporting, the online parity sentinel, and the offline
                   divergence audit (``cli``/tools entry points)
+- ``tracing``   — decision-trace extraction + first-divergence
+                  localization across engines (``cli trace-diff``)
 - ``exporter``  — OpenMetrics text export + heartbeat liveness
                   (``cli export-metrics`` / ``cli watch``)
 - ``compare``   — cross-run regression gating (``cli compare``,
@@ -37,6 +39,10 @@ from fks_tpu.obs.recorder import (
 )
 from fks_tpu.obs.report import render_report, sparkline
 from fks_tpu.obs.spans import span, span_path
+from fks_tpu.obs.tracing import (
+    align_traces, candidate_trace_diff, extract_trace, format_diff,
+    trace_diff,
+)
 from fks_tpu.obs.telemetry import (
     CompileWatcher, device_snapshot, mesh_snapshot, record_devices,
     record_mesh, watch_compiles,
@@ -49,10 +55,12 @@ from fks_tpu.obs.watchdog import (
 __all__ = [
     "DEFAULT_THRESHOLDS", "FLAG_INF", "FLAG_NAN", "FLAG_RANGE", "NULL",
     "CompileWatcher", "EvolutionLedger", "FlightRecorder", "NullRecorder",
-    "ParitySentinel", "Threshold", "check_result", "combined_flags",
-    "compare_runs", "describe_flags", "device_snapshot", "extract_metrics",
-    "format_comparison", "get_recorder", "has_regression", "health_line",
-    "mesh_snapshot", "parse_threshold_overrides", "record_devices",
-    "record_mesh", "recording", "render_report", "run_health", "span",
-    "span_path", "sparkline", "to_openmetrics", "watch", "watch_compiles",
+    "ParitySentinel", "Threshold", "align_traces", "candidate_trace_diff",
+    "check_result", "combined_flags", "compare_runs", "describe_flags",
+    "device_snapshot", "extract_metrics", "extract_trace",
+    "format_comparison", "format_diff", "get_recorder", "has_regression",
+    "health_line", "mesh_snapshot", "parse_threshold_overrides",
+    "record_devices", "record_mesh", "recording", "render_report",
+    "run_health", "span", "span_path", "sparkline", "to_openmetrics",
+    "trace_diff", "watch", "watch_compiles",
 ]

@@ -172,10 +172,33 @@ def _bench_section(metrics: List[Dict[str, Any]]) -> List[str]:
     for s in stages:
         parts = [f"bench stage {s.get('stage', '?')}:"]
         for k in ("evals_per_sec", "code_evals_per_sec", "compile_seconds",
-                  "first_call_seconds", "steady_state_seconds"):
+                  "first_call_seconds", "steady_state_seconds",
+                  "cost_flops", "cost_bytes_accessed"):
             if k in s:
                 parts.append(f"{k}={_num(float(s[k]), 3)}")
         lines.append(" ".join(parts))
+    return lines
+
+
+def _trace_diff_lines(events: List[Dict[str, Any]]) -> List[str]:
+    """Header summary of recorded engine trace-diffs: total count, how
+    many diverged, and the earliest divergent step per engine pair."""
+    diffs = [e for e in events if e.get("kind") == "trace_diff"]
+    if not diffs:
+        return []
+    divergent = [d for d in diffs if d.get("divergent")]
+    lines = [f"trace diffs: {len(diffs)} recorded, "
+             f"{len(divergent)} divergent"]
+    earliest: Dict[str, int] = {}
+    for d in divergent:
+        pair = " vs ".join(d.get("engines", ["?", "?"]))
+        step = (d.get("first_divergence") or {}).get("step")
+        if step is None:
+            continue
+        if pair not in earliest or step < earliest[pair]:
+            earliest[pair] = step
+    for pair in sorted(earliest):
+        lines.append(f"  {pair}: first divergent step {earliest[pair]}")
     return lines
 
 
@@ -201,6 +224,7 @@ def render_report(run_dir: str) -> str:
     for key in ("argv", "best_score", "workload"):
         if key in meta:
             lines.append(f"{key}: {meta[key]}")
+    lines.extend(_trace_diff_lines(events))
     for section in (_infra_section(events), _generation_section(metrics),
                     _bench_section(metrics), _compile_section(events),
                     _span_section(events)):

@@ -92,7 +92,11 @@ class ParitySentinel:
     catches VM-lowering and transpiler drift that champion rescoring —
     which rides the same VM — cannot see. Results land in the run dir as
     ``kind="parity"`` metrics; drift above ``tol`` raises an ``alert``
-    event and increments ``self.alerts`` (the CLI exit policy).
+    event and increments ``self.alerts`` (the CLI exit policy). With
+    ``trace_diff=True`` (default) an alert additionally replays the worst
+    offender through ``fks_tpu.obs.tracing.candidate_trace_diff`` and
+    attaches the first divergent scheduling step to the alert event —
+    best-effort, never fatal to the search.
 
     NOTE on tolerance: the default 1e-5 assumes the search engine is
     ``exact`` (integer/deterministic — any drift is a real lowering
@@ -103,12 +107,13 @@ class ParitySentinel:
     """
 
     def __init__(self, evaluator, sample: int = 0, tol: float = 1e-5,
-                 seed: int = 0, recorder=None):
+                 seed: int = 0, recorder=None, trace_diff: bool = True):
         self.evaluator = evaluator
         self.sample = int(sample)
         self.tol = float(tol)
         self.rng = random.Random(seed)
         self.recorder = recorder if recorder is not None else get_recorder()
+        self.trace_diff = bool(trace_diff)  # auto root-cause on alert
         self.alerts = 0
         self.checked = 0
         self.max_drift = 0.0
@@ -150,6 +155,7 @@ class ParitySentinel:
                                 min(self.sample, len(population)))
         drifts: List[float] = []
         failed = 0
+        worst: Optional[Tuple[float, str]] = None  # (drift, code)
         with self._cpu_device():
             ref = self._reference()
             for code, fitness in picks:
@@ -161,7 +167,10 @@ class ParitySentinel:
                 if not rec.ok:
                     failed += 1
                     continue
-                drifts.append(abs(float(rec.score) - float(fitness)))
+                d = abs(float(rec.score) - float(fitness))
+                drifts.append(d)
+                if worst is None or d > worst[0]:
+                    worst = (d, code)
         self.checked += len(drifts)
         gen_max = max(drifts) if drifts else 0.0
         self.max_drift = max(self.max_drift, gen_max)
@@ -174,12 +183,38 @@ class ParitySentinel:
         if gen_max > self.tol:
             self.alerts += 1
             stats["alerts"] = 1
-            self.recorder.event(
-                "alert", source="parity", generation=int(generation),
+            alert_fields = dict(
+                source="parity", generation=int(generation),
                 max_drift=round(gen_max, 8), tol=self.tol,
                 detail=f"fitness drift {gen_max:.3g} exceeds "
                        f"tolerance {self.tol:.3g}")
+            if self.trace_diff and worst is not None:
+                div = self._diff_offender(worst[1], generation)
+                if div is not None:
+                    # the alert arrives with its root cause attached: the
+                    # first scheduling step where the offender's search
+                    # evaluation departed from the exact/jit reference
+                    alert_fields["first_divergence"] = \
+                        div.get("first_divergence")
+                    alert_fields["diff_engines"] = div.get("engines")
+            self.recorder.event("alert", **alert_fields)
         return stats
+
+    def _diff_offender(self, code: str, generation: int) -> Optional[dict]:
+        """Best-effort root-cause localization for an alert: trace-diff
+        the worst offender's search-tier evaluation against the exact
+        reference (fks_tpu.obs.tracing.candidate_trace_diff). Never
+        raises — the sentinel must not take down the search."""
+        try:
+            from fks_tpu.obs import tracing
+            with self._cpu_device():
+                return tracing.candidate_trace_diff(
+                    self.evaluator, code, recorder=self.recorder,
+                    label=f"parity_alert_gen{int(generation)}")
+        except Exception as e:  # noqa: BLE001
+            self.recorder.event("probe_failure", attempt="trace_diff",
+                                error=f"{type(e).__name__}: {e}")
+            return None
 
 
 # ---------------------------------------------------------------------------

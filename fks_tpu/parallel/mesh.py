@@ -190,13 +190,16 @@ def shard_population(params, mesh: Mesh):
 _shard_params = shard_population  # internal alias, kept for call sites
 
 
-def _global_scores(run, state0, params_shard, axes):
-    """Per-shard batched fitness + the all-gather of the full population
+def _global_results(run, state0, params_shard, axes):
+    """Per-shard batched SimResult + the all-gather of the full population
     fitness vector (shared preamble of eval and generation-step). On a 1-D
     mesh the gather rides ICI only; on a hybrid mesh XLA decomposes the
-    multi-axis gather into ICI-within-slice + one DCN hop."""
-    local_scores = run(params_shard, state0).policy_score
-    return local_scores, jax.lax.all_gather(local_scores, axes, tiled=True)
+    multi-axis gather into ICI-within-slice + one DCN hop. The full result
+    stays shard-local (only the scalar score is gathered) so per-lane
+    observables — the decision TraceBuffer included — ride out through the
+    caller's sharded out_specs without crossing the interconnect."""
+    res = run(params_shard, state0)
+    return res, jax.lax.all_gather(res.policy_score, axes, tiled=True)
 
 
 def _mask_pad(scores, real_count):
@@ -251,21 +254,29 @@ def make_sharded_eval(workload: Workload, mesh: Mesh,
     ICI axis and every device computes the identical global top-k — the elite
     set used for parent sampling and truncation (reference semantics: sort
     desc + take elite_size, funsearch_integration.py:494-496).
+
+    With ``cfg.decision_trace`` the tuple grows a fourth element: the
+    per-candidate TraceBuffer pytree, sharded over ``pop`` like the scores
+    (a ``P(axes)`` out_spec prefix over the whole subtree). Existing
+    callers index the first three slots, so the extension is opt-in.
     """
     run, state0 = _engine_runner(workload, param_policy, cfg, engine)
     axes = _pop_axes(mesh)
+    out_specs = (P(axes), P(), P()) + ((P(axes),) if cfg.decision_trace else ())
 
     @functools.partial(
         shard_map, mesh=mesh,
         in_specs=(P(axes), P()),
-        out_specs=(P(axes), P(), P()),
+        out_specs=out_specs,
         check_vma=False,
     )
     def shard_eval(params_shard, real_count):
-        local_scores, global_scores = _global_scores(
-            run, state0, params_shard, axes)
+        res, global_scores = _global_results(run, state0, params_shard, axes)
         elite_scores, elite_idx = _top_k_real(global_scores, real_count, elite_k)
-        return local_scores, elite_idx, elite_scores
+        out = (res.policy_score, elite_idx, elite_scores)
+        if cfg.decision_trace:
+            out = out + (res.trace,)
+        return out
 
     def sharded_eval(params, real_count=None):
         params = _shard_params(params, mesh)
@@ -304,8 +315,8 @@ def make_sharded_generation_step(workload: Workload, mesh: Mesh,
         check_vma=False,
     )
     def gen_step(params_shard, key, real_count):
-        local_scores, global_scores = _global_scores(
-            run, state0, params_shard, axes)
+        res, global_scores = _global_results(run, state0, params_shard, axes)
+        local_scores = res.policy_score
         all_params = jax.lax.all_gather(params_shard, axes, tiled=True)
         elite_scores, elite_idx = _top_k_real(global_scores, real_count, elite_k)
         elites = all_params[elite_idx]

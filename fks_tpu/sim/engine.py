@@ -44,7 +44,10 @@ from fks_tpu.ops.heap import (
 )
 from fks_tpu.sim.evaluator import max_snapshot_count, snapshot_trigger_table
 from fks_tpu.sim.guards import fitness_flags, sanitize_scores, score_flags
-from fks_tpu.sim.types import NodeView, PodView, PolicyFn, SimResult, SimState
+from fks_tpu.sim.types import (
+    TRACE_CREATE, TRACE_DELETE, TRACE_RETRY,
+    NodeView, PodView, PolicyFn, SimResult, SimState, TraceBuffer, empty_trace,
+)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -80,11 +83,24 @@ class SimConfig:
     # NaN/Inf/out-of-[0,1]. Python-static, so the disabled path compiles
     # to the exact same program as a build without guards.
     watchdog: bool = False
+    # decision-trace instrument (fks_tpu.obs.tracing): log one row per
+    # processed event — kind (CREATE/DELETE/RETRY), pod id, chosen node,
+    # winning score + second-best margin, pending count, post-step free
+    # aggregates — into a bounded TraceBuffer carried in the engine state.
+    # Python-static like ``watchdog``: disabled, the state's trace field is
+    # None (zero pytree leaves) and the compiled program is identical.
+    decision_trace: bool = False
+    trace_len: Optional[int] = None  # trace rows; default resolve_max_steps
 
     def resolve_max_steps(self, num_pods: int) -> int:
         if self.max_steps is not None:
             return self.max_steps
         return max(64, self.max_steps_factor * num_pods)
+
+    def resolve_trace_len(self, num_pods: int) -> int:
+        if self.trace_len is not None:
+            return self.trace_len
+        return self.resolve_max_steps(num_pods)
 
 
 def initial_state(workload: Workload, cfg: SimConfig) -> SimState:
@@ -131,6 +147,8 @@ def initial_state(workload: Workload, cfg: SimConfig) -> SimState:
         steps=jnp.int32(0),
         violations=jnp.int32(0),
         numeric_flags=jnp.int32(0),
+        trace=(empty_trace(cfg.resolve_trace_len(workload.num_pods), f)
+               if cfg.decision_trace else None),
     )
 
 
@@ -138,6 +156,46 @@ def _widest_int():
     """Accumulation dtype for cluster-wide integer sums: int64 when x64 is
     enabled, else int32 (on by default on TPU, where 64-bit is emulated)."""
     return jnp.int64 if jax.config.jax_enable_x64 else jnp.int32
+
+
+def _trace_append(trace: TraceBuffer, *, active, create, is_del, was_waiting,
+                  pod, node, scores, winner, pending,
+                  cpu_left, mem_left, gpu_left, gpu_milli_left) -> TraceBuffer:
+    """Append one decision row (see TraceBuffer column docs). Shared by the
+    exact and flat engines so the recorded vocabulary cannot drift between
+    them. Self-masking: an inactive step, or a full buffer, appends via an
+    out-of-range index whose scatter drops. Deletes record score/margin 0
+    (the step's score vector is undefined on non-creation events under
+    ``cond_policy``), keeping row content engine-deterministic."""
+    tlen = trace.data.shape[0]
+    kind = jnp.where(is_del, TRACE_DELETE,
+                     jnp.where(was_waiting, TRACE_RETRY, TRACE_CREATE))
+    wi = _widest_int()
+    row = jnp.stack([
+        kind.astype(jnp.int32), pod.astype(jnp.int32),
+        node.astype(jnp.int32), pending.astype(jnp.int32),
+        jnp.sum(cpu_left, dtype=wi).astype(jnp.int32),
+        jnp.sum(mem_left, dtype=wi).astype(jnp.int32),
+        jnp.sum(gpu_left, dtype=wi).astype(jnp.int32),
+        jnp.sum(gpu_milli_left, dtype=wi).astype(jnp.int32),
+    ])
+    sdt = trace.scores.dtype
+    win = scores[winner].astype(sdt)
+    if scores.shape[0] > 1:
+        others = jnp.where(jnp.arange(scores.shape[0]) == winner,
+                           -jnp.inf, scores.astype(sdt))
+        margin = win - jnp.max(others)
+    else:
+        margin = jnp.zeros_like(win)
+    win = jnp.where(create, win, 0)
+    margin = jnp.where(create, margin, 0)
+    write = active & (trace.count < tlen)
+    idx = jnp.where(write, trace.count, tlen)
+    return TraceBuffer(
+        data=trace.data.at[idx].set(row, mode="drop"),
+        scores=trace.scores.at[idx].set(jnp.stack([win, margin]), mode="drop"),
+        count=trace.count + write.astype(jnp.int32),
+    )
 
 
 def _node_view(c: ClusterArrays, cpu_left, mem_left, gpu_left, gpu_milli_left):
@@ -345,6 +403,16 @@ def build_step(workload: Workload, policy: PolicyFn, cfg: SimConfig,
                 jax.lax.bitcast_convert_type(
                     pod_state[:, SimState.COL_BITS], jnp.uint32))
 
+        trace = s.trace
+        if cfg.decision_trace:
+            trace = _trace_append(
+                trace, active=active, create=create, is_del=is_del,
+                was_waiting=was_waiting, pod=pod,
+                node=jnp.where(is_del, held_node, jnp.where(pl, b, -1)),
+                scores=scores, winner=b, pending=heap3.size,
+                cpu_left=cpu_left, mem_left=mem_left, gpu_left=gpu_left,
+                gpu_milli_left=gpu_milli_left)
+
         return SimState(
             heap=heap3, cpu_left=cpu_left, mem_left=mem_left,
             gpu_left=gpu_left, gpu_milli_left=gpu_milli_left,
@@ -353,6 +421,7 @@ def build_step(workload: Workload, policy: PolicyFn, cfg: SimConfig,
             frag_sum=frag_sum, frag_count=frag_count, max_nodes=max_nodes,
             failed=s.failed | alloc_fail, steps=s.steps + active.astype(jnp.int32),
             violations=violations, numeric_flags=numeric_flags,
+            trace=trace,
         )
 
     return step
@@ -445,6 +514,7 @@ def finalize_fields(workload: Workload, cfg: SimConfig, *, pending, s) -> SimRes
         cpu_left=s.cpu_left, mem_left=s.mem_left, gpu_left=s.gpu_left,
         gpu_milli_left=s.gpu_milli_left, failed=s.failed, truncated=truncated,
         invariant_violations=s.violations, numeric_flags=numeric_flags,
+        trace=getattr(s, "trace", None),
     )
 
 

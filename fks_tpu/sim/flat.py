@@ -77,11 +77,11 @@ import numpy as np
 from fks_tpu.data.entities import Workload
 from fks_tpu.ops.allocator import best_fit_gpus, first_fit_gpus
 from fks_tpu.sim.engine import (
-    SimConfig, _audit, _node_view, _widest_int, finalize_fields,
-    loop_tables, run_batched_lanes,
+    SimConfig, _audit, _node_view, _trace_append, _widest_int,
+    finalize_fields, loop_tables, run_batched_lanes,
 )
 from fks_tpu.sim.guards import sanitize_scores, score_flags
-from fks_tpu.sim.types import FlatState, PodView, PolicyFn, SimResult
+from fks_tpu.sim.types import FlatState, PodView, PolicyFn, SimResult, empty_trace
 
 INF = jnp.iinfo(jnp.int32).max  # empty-slot sentinel
 
@@ -150,6 +150,8 @@ def initial_state(workload: Workload, cfg: SimConfig) -> FlatState:
         steps=jnp.int32(0),
         violations=jnp.int32(0),
         numeric_flags=jnp.int32(0),
+        trace=(empty_trace(cfg.resolve_trace_len(workload.num_pods), f)
+               if cfg.decision_trace else None),
     )
 
 
@@ -354,6 +356,18 @@ def build_step(workload: Workload, policy: PolicyFn, cfg: SimConfig,
                 c, p_rank, active_pods, cpu_left, mem_left, gpu_left,
                 gpu_milli_left, an, ag)
 
+        trace = s.trace
+        if cfg.decision_trace:
+            # pod column holds perm[sidx] — the ORIGINAL input-order pod id
+            # — so rows align with the exact engine's without un-permuting.
+            trace = _trace_append(
+                trace, active=active, create=create, is_del=is_del,
+                was_waiting=was_waiting, pod=perm[sidx],
+                node=jnp.where(is_del, held_node, jnp.where(pl, w, -1)),
+                scores=scores, winner=w, pending=pending,
+                cpu_left=cpu_left, mem_left=mem_left, gpu_left=gpu_left,
+                gpu_milli_left=gpu_milli_left)
+
         return FlatState(
             ev_time=ev_time, aux=aux, aux_gpus=aux_gpus, pending=pending,
             cpu_left=cpu_left, mem_left=mem_left, gpu_left=gpu_left,
@@ -362,7 +376,7 @@ def build_step(workload: Workload, policy: PolicyFn, cfg: SimConfig,
             snap_sums=snap_sums, frag_sum=frag_sum, frag_count=frag_count,
             max_nodes=max_nodes, failed=s.failed | alloc_fail,
             steps=s.steps + active.astype(jnp.int32), violations=violations,
-            numeric_flags=numeric_flags,
+            numeric_flags=numeric_flags, trace=trace,
         )
 
     return step
@@ -402,6 +416,7 @@ class _FinalView(NamedTuple):
     failed: Any
     violations: Any
     numeric_flags: Any
+    trace: Any = None
 
 
 def finalize(workload: Workload, cfg: SimConfig, s: FlatState) -> SimResult:
@@ -418,7 +433,7 @@ def finalize(workload: Workload, cfg: SimConfig, s: FlatState) -> SimResult:
         events_processed=s.events_processed, snap_idx=s.snap_idx,
         snap_sums=s.snap_sums, frag_sum=s.frag_sum, frag_count=s.frag_count,
         max_nodes=s.max_nodes, failed=s.failed, violations=s.violations,
-        numeric_flags=s.numeric_flags,
+        numeric_flags=s.numeric_flags, trace=s.trace,
     )
     return finalize_fields(workload, cfg, pending=s.pending > 0, s=view)
 

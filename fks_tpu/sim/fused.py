@@ -102,6 +102,10 @@ def _build_plan(workload: Workload, cfg: SimConfig) -> _Plan:
     if cfg.validate_invariants:
         raise ValueError("invariant audit is not supported in the fused "
                          "kernel; use engine='flat'")
+    if cfg.decision_trace:
+        raise ValueError("decision trace is not supported in the fused "
+                         "kernel; replay with engine='exact' or 'flat' "
+                         "(fks_tpu.obs.tracing / cli trace-diff)")
     q = _round_up(pp, 128)
 
     pm = np.asarray(p.pod_mask)

@@ -54,6 +54,50 @@ class NodeView(NamedTuple):
 PolicyFn = Callable[[PodView, NodeView], Any]  # -> i32[N]
 
 
+# decision-trace event kinds (TraceBuffer COL_KIND values). RETRY marks a
+# creation attempt of a pod that already failed at least once.
+TRACE_CREATE = 0
+TRACE_DELETE = 1
+TRACE_RETRY = 2
+TRACE_KIND_NAMES = ("CREATE", "DELETE", "RETRY")
+
+
+class TraceBuffer(NamedTuple):
+    """Bounded per-step decision log carried in the engine state (see
+    ``SimConfig.decision_trace``): one row per processed event, filled
+    inside the jitted step and appended with a dropped out-of-range
+    scatter once full. Integer observables live as COLUMNS of one
+    ``i32[T, 8]`` matrix (single row-scatter per event, the ``pod_state``
+    layout rationale); the two float observables (winning score,
+    second-best margin) ride in a separate ``f[T, 2]`` so the score dtype
+    survives. Rows are comparable ACROSS engines: pod ids are original
+    input order (the flat engine un-permutes its slot index on write) and
+    deletes record score/margin as 0."""
+
+    data: Any  # i32[T, 8], columns below
+    scores: Any  # f[T, 2]: (winning score, second-best margin)
+    count: Any  # i32 rows written (saturates at T; appends then drop)
+
+    # data column indices
+    COL_KIND = 0  # TRACE_CREATE / TRACE_DELETE / TRACE_RETRY
+    COL_POD = 1  # original input-order pod id
+    COL_NODE = 2  # chosen node (-1 = failed/none); held node on DELETE
+    COL_PENDING = 3  # post-step pending event count
+    COL_FREE_CPU = 4  # post-step cluster-wide free aggregates
+    COL_FREE_MEM = 5
+    COL_FREE_GPU = 6
+    COL_FREE_GPU_MILLI = 7
+
+
+def empty_trace(length: int, score_dtype: Any = jnp.float32) -> TraceBuffer:
+    """An all-zero ``TraceBuffer`` with ``length`` rows."""
+    return TraceBuffer(
+        data=jnp.zeros((length, 8), jnp.int32),
+        scores=jnp.zeros((length, 2), score_dtype),
+        count=jnp.int32(0),
+    )
+
+
 class SimState(NamedTuple):
     """The lax.while_loop carry: complete simulation + evaluator state.
 
@@ -87,6 +131,10 @@ class SimState(NamedTuple):
     steps: Any  # i32
     violations: Any  # i32: invariant-audit failures (0 unless enabled)
     numeric_flags: Any  # i32 watchdog bitmask (0 unless SimConfig.watchdog)
+    # TraceBuffer, or None unless SimConfig.decision_trace. None adds zero
+    # pytree leaves, so the disabled path's carry structure — and therefore
+    # the compiled program — is bit-identical to a build without tracing.
+    trace: Any = None
 
     # pod_state column indices
     COL_NODE = 0
@@ -144,6 +192,7 @@ class FlatState(NamedTuple):
     steps: Any
     violations: Any
     numeric_flags: Any  # i32 watchdog bitmask (0 unless SimConfig.watchdog)
+    trace: Any = None  # TraceBuffer or None (see SimState.trace)
 
 
 class SimResult(NamedTuple):
@@ -174,3 +223,6 @@ class SimResult(NamedTuple):
     # i32 watchdog bitmask (sim.guards.FLAG_*; 0 unless SimConfig.watchdog):
     # sticky OR of per-step policy-score violations + final fitness check
     numeric_flags: Any
+    # decision TraceBuffer, or None unless SimConfig.decision_trace
+    # (fks_tpu.obs.tracing extracts/aligns it)
+    trace: Any = None

@@ -12,9 +12,11 @@ Two jobs, one helper:
 
 Beyond the generic ts/kind floor, records of KNOWN kinds (the watchdog /
 alert / parity / probe_failure vocabulary added with the numerics
-watchdog, plus the evolution ledger's generation records) are checked
-for their kind-specific required keys — a watchdog event without a flag
-mask is as corrupt as a line without a timestamp.
+watchdog, plus the evolution ledger's generation records, plus the
+``decision_trace``/``trace_diff`` records from fks_tpu.obs.tracing —
+whose embedded trace rows must carry a known CREATE/DELETE/RETRY event
+kind) are checked for their kind-specific required keys — a watchdog
+event without a flag mask is as corrupt as a line without a timestamp.
 
 ``check_openmetrics(text)`` validates the ``cli export-metrics`` output:
 every exposition line is a comment, a ``# TYPE``/``# HELP`` header, or a
@@ -56,7 +58,13 @@ EVENT_KIND_REQUIRED: Dict[str, Tuple[str, ...]] = {
     "probe_failure": ("attempt",),
     "span": ("seconds",),
     "compile": ("seconds",),
+    "decision_trace": ("engine", "events"),
+    "trace_diff": ("engines", "divergent"),
 }
+
+#: legal event kinds inside an embedded decision-trace row (must match
+#: fks_tpu.sim.types.TRACE_KIND_NAMES)
+TRACE_EVENT_KINDS = {"CREATE", "DELETE", "RETRY"}
 METRIC_KIND_REQUIRED: Dict[str, Tuple[str, ...]] = {
     "generation": ("generation", "best_score"),
     "parity": ("generation", "checked", "max_drift"),
@@ -125,6 +133,32 @@ def check_kinds(path: str, records: List[dict],
             raise SchemaError(
                 f"{path}: record {i + 1} (kind={rec.get('kind')!r}): "
                 f"missing {missing}")
+        if rec.get("kind") == "decision_trace":
+            _check_embedded_events(path, i, rec.get("events", []))
+        elif rec.get("kind") == "trace_diff":
+            div = rec.get("first_divergence") or {}
+            _check_embedded_events(
+                path, i, [r for r in (div.get("a"), div.get("b")) if r])
+
+
+def _check_embedded_events(path: str, idx: int, rows) -> None:
+    """Decision-trace rows embedded in a record must be dicts whose
+    ``kind`` is in the engine's event vocabulary — an unknown kind means
+    a corrupt trace (or a vocabulary drift between writer and checker)."""
+    if not isinstance(rows, (list, tuple)):
+        raise SchemaError(
+            f"{path}: record {idx + 1}: embedded events not a list "
+            f"({type(rows).__name__})")
+    for j, row in enumerate(rows):
+        if not isinstance(row, dict):
+            raise SchemaError(
+                f"{path}: record {idx + 1}: trace row {j + 1} not an "
+                f"object ({type(row).__name__})")
+        if row.get("kind") not in TRACE_EVENT_KINDS:
+            raise SchemaError(
+                f"{path}: record {idx + 1}: trace row {j + 1} has unknown "
+                f"event kind {row.get('kind')!r} "
+                f"(expect one of {sorted(TRACE_EVENT_KINDS)})")
 
 
 def check_openmetrics(text: str, path: str = "<openmetrics>") -> int:

@@ -9,6 +9,12 @@ it, and ``cli compare`` of the fixture against itself must exit 0 — the
 two tools CI leans on must agree that a known-good run dir is good
 before their verdicts on real runs mean anything. A gate failure is
 recorded in the evidence row (``obs_gate``) and fails the suite run.
+
+A TRACE GATE follows: ``cli trace-diff`` of the exact engine against
+itself on the default trace must report zero divergence (exit 0). The
+decision-trace instrument comparing an engine to itself and finding a
+difference means the trace capture or alignment is broken — its verdicts
+on real engine pairs would be noise. Recorded as ``trace_gate``.
 """
 from __future__ import annotations
 
@@ -43,6 +49,22 @@ def obs_gate() -> dict:
     return {"ok": ok, **detail}
 
 
+def trace_gate() -> dict:
+    """Trace-diff self-consistency: exact-vs-exact on the default trace
+    must exit 0 (no divergence). Returns {"ok": bool, ...}."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "-m", "fks_tpu.cli", "trace-diff", "--cpu",
+         "--engines", "exact,exact", "--policy", "first_fit",
+         "--max-steps", "256"],
+        capture_output=True, text=True, cwd=REPO, env=env)
+    ok = proc.returncode == 0
+    detail = {"rc": proc.returncode}
+    if not ok:
+        detail["err"] = (proc.stderr or proc.stdout or "")[-500:]
+    return {"ok": ok, **detail}
+
+
 def main() -> int:
     rev = subprocess.run(["git", "rev-parse", "--short", "HEAD"],
                          capture_output=True, text=True, cwd=REPO
@@ -50,6 +72,9 @@ def main() -> int:
     gate = obs_gate()
     if not gate["ok"]:
         print(f"OBS GATE FAILED: {gate}", file=sys.stderr)
+    tgate = trace_gate()
+    if not tgate["ok"]:
+        print(f"TRACE GATE FAILED: {tgate}", file=sys.stderr)
     t0 = time.time()
     proc = subprocess.run(
         [sys.executable, "-m", "pytest", "tests/", "-q",
@@ -60,15 +85,17 @@ def main() -> int:
     summary = tail[0] if tail else ""
     counts = {k: int(v) for v, k in re.findall(
         r"(\d+) (passed|failed|error|skipped|deselected|xfailed)", summary)}
-    row = {"ts": round(time.time(), 1), "rev": rev,
-           "rc": proc.returncode if gate["ok"] else (proc.returncode or 1),
-           "wall_s": wall, **counts, "obs_gate": gate, "summary": summary}
+    gates_ok = gate["ok"] and tgate["ok"]
+    rc = proc.returncode if gates_ok else (proc.returncode or 1)
+    row = {"ts": round(time.time(), 1), "rev": rev, "rc": rc,
+           "wall_s": wall, **counts, "obs_gate": gate,
+           "trace_gate": tgate, "summary": summary}
     os.makedirs(os.path.dirname(OUT), exist_ok=True)
     with open(OUT, "a") as f:
         f.write(json.dumps(row) + "\n")
     print(json.dumps(row))
     sys.stderr.write((proc.stdout or "")[-2000:])
-    return proc.returncode if gate["ok"] else (proc.returncode or 1)
+    return rc
 
 
 if __name__ == "__main__":
