@@ -18,6 +18,7 @@ Usage:
     python -m fks_tpu.cli watch RUN_DIR [--interval S] [--once]
     python -m fks_tpu.cli compare BASELINE CANDIDATE [--threshold m=rel:X]
     python -m fks_tpu.cli trace-diff --engines exact,flat [--policy P | --code F]
+    python -m fks_tpu.cli scenarios [--suite NAME [--scenario I]]
     python -m fks_tpu.cli traces
 
 Every subcommand accepts ``--run-dir DIR`` to flight-record the run
@@ -306,6 +307,10 @@ def cmd_evolve(args):
         cfg.parity_sample = args.parity_sample
     if args.parity_tol is not None:
         cfg.parity_tol = args.parity_tol
+    if args.suite is not None:
+        cfg.scenario_suite = args.suite
+    if args.robust_agg is not None:
+        cfg.robust_aggregation = args.robust_agg
     backend = FakeLLM(seed=cfg.seed) if args.fake_llm else None
     if backend is None and not cfg.llm.api_key:
         print("no API key in config; use --fake-llm for hermetic runs",
@@ -596,6 +601,26 @@ def cmd_trace_diff(args):
               file=sys.stderr)
         return 2
     _, wl = _parse_workload(args)
+    label = args.code or args.policy
+    if args.scenario is not None:
+        # replay on one suite scenario (fault-injected variants included:
+        # both trace engines carry NODE_DOWN/NODE_UP rows) instead of the
+        # base workload
+        from fks_tpu.scenarios import get_suite
+
+        try:
+            suite = get_suite(args.suite, wl)
+        except ValueError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        if not 0 <= args.scenario < len(suite):
+            print(f"error: --scenario {args.scenario} out of range for "
+                  f"suite {suite.name!r} ({len(suite)} scenarios)",
+                  file=sys.stderr)
+            return 2
+        wl = suite.workloads[args.scenario]
+        label = (f"{label}@{suite.name}"
+                 f"[{args.scenario}:{suite.names[args.scenario]}]")
     code = ""
     if args.code:
         try:
@@ -622,9 +647,59 @@ def cmd_trace_diff(args):
     with _flight_recorder(args, "trace-diff") as rec:
         record = tracing.trace_diff(
             wl, specs, cfg=SimConfig(**cfg_kw), score_tol=args.tol,
-            recorder=rec, label=(args.code or args.policy))
+            recorder=rec, label=label)
     print(tracing.format_diff(record))
     return 1 if record["divergent"] else 0
+
+
+def cmd_scenarios(args):
+    """Scenario-suite discovery and inspection (fks_tpu.scenarios): with no
+    flags, list the registered suites; with ``--suite`` materialize one
+    against the workload and print its summary (per-scenario parameters +
+    fault-event counts); with ``--scenario I`` zoom into one scenario,
+    including its concrete NODE_DOWN/NODE_UP timeline. ``--run-dir``
+    additionally lands the suite summary in the flight-recorder trail as a
+    ``scenario_suite`` metric, tying an evolve run's robust scores to the
+    exact scenario family they were measured on."""
+    from fks_tpu.scenarios import list_suites
+
+    if not args.suite:
+        print(json.dumps(list_suites(), indent=2))
+        return 0
+    _apply_platform_flags(args)
+    import numpy as np
+
+    from fks_tpu.ops.heap import KIND_NODE_DOWN
+    from fks_tpu.scenarios import get_suite
+
+    _, wl = _parse_workload(args)
+    with _flight_recorder(args, "scenarios") as rec:
+        try:
+            suite = get_suite(args.suite, wl)
+        except ValueError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        desc = suite.describe()
+        rec.metric("scenario_suite", desc)
+        if args.scenario is None:
+            print(json.dumps(desc, indent=2))
+            return 0
+        if not 0 <= args.scenario < len(suite):
+            print(f"error: --scenario {args.scenario} out of range for "
+                  f"suite {suite.name!r} ({len(suite)} scenarios)",
+                  file=sys.stderr)
+            return 2
+        fe = suite.workloads[args.scenario].faults
+        m = np.asarray(fe.mask)
+        row = dict(desc["scenarios"][args.scenario], fault_timeline=[
+            {"time": int(t), "node": int(nd),
+             "kind": ("NODE_DOWN" if int(k) == KIND_NODE_DOWN
+                      else "NODE_UP")}
+            for t, nd, k in zip(np.asarray(fe.time)[m],
+                                np.asarray(fe.node)[m],
+                                np.asarray(fe.kind)[m])])
+    print(json.dumps(row, indent=2))
+    return 0
 
 
 def cmd_traces(args):
@@ -707,6 +782,17 @@ def main(argv=None) -> int:
                    help="parity drift tolerance (default 1e-5; raise "
                         "above the measured divergence bound for "
                         "--engine flat)")
+    e.add_argument("--suite", default=None,
+                   help="score candidates by composite ROBUST fitness over "
+                        "this scenario suite (fks_tpu.scenarios; try "
+                        "'default8') instead of single-trace fitness — "
+                        "one vmapped evaluation covers every scenario, "
+                        "fault-injected variants included")
+    e.add_argument("--robust-agg", choices=("mean", "min", "cvar"),
+                   default=None,
+                   help="how per-scenario scores fold into the robust "
+                        "score (default mean; cvar = mean of the worst "
+                        "quarter)")
     e.set_defaults(fn=cmd_evolve)
 
     sc = sub.add_parser("scale", help="synthetic scale run + throughput",
@@ -774,6 +860,13 @@ def main(argv=None) -> int:
     td.add_argument("--code", default="",
                     help="candidate source file to replay on the "
                          "funsearch VM instead of a zoo policy")
+    td.add_argument("--suite", default="default8",
+                    help="scenario suite providing --scenario variants "
+                         "(default default8)")
+    td.add_argument("--scenario", type=int, default=None,
+                    help="replay on suite scenario INDEX (0-based) instead "
+                         "of the base workload — fault-injected scenarios "
+                         "diff NODE_DOWN/NODE_UP rows too")
     td.add_argument("--max-steps", type=int, default=0,
                     help="cap replay steps (0 = engine default)")
     td.add_argument("--tol", type=float, default=1e-5,
@@ -784,6 +877,19 @@ def main(argv=None) -> int:
                     help="flight-recorder run directory for the "
                          "decision_trace / trace_diff records")
     td.set_defaults(fn=cmd_trace_diff)
+
+    sn = sub.add_parser("scenarios",
+                        help="list scenario suites / describe one suite "
+                             "or scenario", parents=[common])
+    _add_trace_flags(sn)
+    sn.add_argument("--suite", default="",
+                    help="materialize this suite against the workload and "
+                         "print its summary (omit to list registered "
+                         "suites)")
+    sn.add_argument("--scenario", type=int, default=None,
+                    help="describe one scenario (0-based index) incl. its "
+                         "fault timeline")
+    sn.set_defaults(fn=cmd_scenarios)
 
     t = sub.add_parser("traces", help="list available trace files")
     t.set_defaults(fn=cmd_traces)

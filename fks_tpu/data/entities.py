@@ -113,11 +113,43 @@ class PodArrays:
 
 
 @_pytree_dataclass
+class FaultEvents:
+    """Precomputed node fault timeline (fks_tpu.scenarios generator).
+
+    One row per NODE_DOWN / NODE_UP event, padded to a fixed length ``F``
+    and masked like every other axis. Faults are *trace events*: both
+    engines merge them into the event stream ahead of equal-time pod
+    events and flip a per-node availability bit (cordon — a downed node
+    scores 0 for new placements; running pods are not evicted), so the
+    jitted step stays a pure scan.
+    """
+
+    time: Any  # i32[F] event times (padding: INT32_MAX)
+    node: Any  # i32[F] node index the event applies to (padding: 0)
+    kind: Any  # i32[F] KIND_NODE_DOWN | KIND_NODE_UP (ops.heap vocabulary)
+    mask: Any  # bool[F] which rows are real
+
+    @property
+    def f_padded(self) -> int:
+        return int(self.time.shape[0])
+
+    @property
+    def num_events(self) -> int:
+        return int(np.sum(np.asarray(self.mask)))
+
+
+@_pytree_dataclass
 class Workload:
-    """A parsed (cluster, pods) pair -- unit of simulation input."""
+    """A parsed (cluster, pods) pair -- unit of simulation input.
+
+    ``faults`` is None for plain workloads (zero pytree leaves — fault-free
+    programs compile unchanged) or a ``FaultEvents`` timeline for
+    scenario-generated variants.
+    """
 
     cluster: ClusterArrays
     pods: PodArrays
+    faults: Any = None
 
     @property
     def num_nodes(self) -> int:

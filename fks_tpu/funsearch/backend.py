@@ -56,6 +56,10 @@ class EvalRecord:
     score: float
     error: Optional[str] = None  # why fitness is 0, when it is
     result: Optional[SimResult] = None
+    # scenario-suite evaluations only: the per-scenario fitness vector the
+    # composite ``score`` was folded from, and the fold that produced it
+    scenario_scores: Optional[List[float]] = None
+    aggregation: Optional[str] = None
 
     @property
     def ok(self) -> bool:
@@ -76,13 +80,31 @@ class CodeEvaluator:
     def __init__(self, workload: Workload, cfg: SimConfig = SimConfig(),
                  max_workers: Optional[int] = None, use_vm: bool = True,
                  engine: str = "exact", vm_batch: Optional[bool] = None,
-                 mesh=None):
+                 mesh=None, suite=None, robust=None):
         from fks_tpu.sim import get_engine
 
         self.workload = workload
         self.cfg = cfg
         self.engine = engine
         self._mod = get_engine(engine)
+        # Scenario-suite mode (fks_tpu.scenarios): with ``suite`` (a
+        # materialized ScenarioSuite over this workload) every candidate is
+        # evaluated on ALL scenarios in one vmapped program and scored by
+        # the composite robust aggregate; EvalRecords carry the
+        # per-scenario breakdown. The jitted fused kernel has no fault
+        # vocabulary (sim/fused.py rejects fault workloads), so suite mode
+        # requires the exact or flat engine.
+        self.suite = suite
+        self.robust = robust
+        if suite is not None:
+            if engine == "fused":
+                raise ValueError(
+                    "scenario suites are not supported on the fused "
+                    "engine (fault events have no Pallas lowering); use "
+                    "engine='exact' or 'flat'")
+            if robust is None:
+                from fks_tpu.scenarios.robust import RobustConfig
+                self.robust = RobustConfig()
         self.state0 = self._mod.initial_state(workload, cfg)
         self._cache: Dict[str, object] = {}
         self._lock = threading.Lock()
@@ -143,12 +165,21 @@ class CodeEvaluator:
 
     def _vm_runner(self):
         if self._vm_run is None:
-            # the VM interpreter is expensive per event; skip it on
-            # deletions (cond_policy) — this tier runs unbatched, where
-            # lax.cond executes one branch
-            cfg = _dc.replace(self.cfg, cond_policy=True)
-            self._vm_run = jax.jit(
-                self._mod.make_param_run_fn(self.workload, vm.score, cfg))
+            if self.suite is not None:
+                # one candidate x all scenarios in one vmapped program;
+                # cond_policy stays off — under the trace vmap a lax.cond
+                # runs both branches anyway
+                from fks_tpu.scenarios.robust import make_suite_eval
+                ev = make_suite_eval(self.suite, vm.score, self.cfg,
+                                     engine=self.engine)
+                self._vm_run = lambda prog, _s: ev(prog)
+            else:
+                # the VM interpreter is expensive per event; skip it on
+                # deletions (cond_policy) — this tier runs unbatched, where
+                # lax.cond executes one branch
+                cfg = _dc.replace(self.cfg, cond_policy=True)
+                self._vm_run = jax.jit(
+                    self._mod.make_param_run_fn(self.workload, vm.score, cfg))
         return self._vm_run
 
     def _try_vm(self, code: str) -> Optional[SimResult]:
@@ -174,6 +205,15 @@ class CodeEvaluator:
 
     def _vm_pop_runner(self):
         if self._vm_pop_run is None:
+            if self.suite is not None:
+                # candidates x scenarios [C, T] from one program; the
+                # segmented runners have no trace-batched variant, so
+                # suite mode always takes the single-dispatch path
+                from fks_tpu.scenarios.robust import make_suite_eval
+                ev = make_suite_eval(self.suite, vm.score_static, self.cfg,
+                                     population=True, engine=self.engine)
+                self._vm_pop_run = lambda progs, _s: ev(progs)
+                return self._vm_pop_run
             # population semantics per SimConfig.cond_policy docs: under
             # vmap a cond runs both branches, so keep cond_policy off and
             # let the self-masking step skip nothing — the batch amortizes
@@ -220,10 +260,14 @@ class CodeEvaluator:
         # device_get materializes the whole generation, so no extra sync
         with span("vm_batch", candidates=len(progs), lanes=pop,
                   shards=self._n_shards):
-            if self._n_shards > 1:
+            if self._n_shards > 1 and self.suite is None:
                 # each device interprets pop/shards lanes; the elite
                 # outputs are discarded here (the evolution loop ranks on
-                # the host, where admission/dedup live)
+                # the host, where admission/dedup live). Suite mode skips
+                # this tier: make_sharded_code_eval has no scenario axis —
+                # the [C, T] population runner serves the batch instead
+                # (mesh-sharded SUITE evaluation lives at the parametric
+                # tier, fks_tpu.scenarios.robust.make_sharded_suite_eval).
                 result, _, _ = self._vm_mesh_runner()(stacked, len(progs))
             else:
                 result = self._vm_pop_runner()(stacked, self.state0)
@@ -236,13 +280,36 @@ class CodeEvaluator:
         return [jax.tree_util.tree_map(lambda x, i=i: x[i], result)
                 for i in range(len(progs))]
 
-    @staticmethod
-    def _record(code: str, result: SimResult) -> EvalRecord:
+    def _record(self, code: str, result: SimResult) -> EvalRecord:
+        if self.suite is not None:
+            return self._record_suite(code, result)
         if bool(result.failed):
             return EvalRecord(code, 0.0, "gpu allocation aborted", result)
         if bool(result.truncated):
             return EvalRecord(code, 0.0, "event budget exceeded", result)
         return EvalRecord(code, float(result.policy_score), None, result)
+
+    def _record_suite(self, code: str, result: SimResult) -> EvalRecord:
+        """Suite-mode record: result leaves carry the scenario axis [T].
+        A scenario that fails scores 0 THERE (finalize already gates the
+        fitness) and drags the aggregate — reference failure semantics
+        applied per scenario; the candidate only errors out when every
+        scenario failed."""
+        from fks_tpu.scenarios.robust import aggregate
+
+        per = np.asarray(result.policy_score, np.float64)
+        breakdown = [float(x) for x in per]
+        agg = self.robust.aggregation
+        failed = np.asarray(result.failed)
+        truncated = np.asarray(result.truncated)
+        if bool(failed.all()):
+            return EvalRecord(code, 0.0, "gpu allocation aborted "
+                              "(all scenarios)", result, breakdown, agg)
+        if bool((failed | truncated).all()):
+            return EvalRecord(code, 0.0, "event budget exceeded "
+                              "(all scenarios)", result, breakdown, agg)
+        score = float(aggregate(per, self.robust))
+        return EvalRecord(code, score, None, result, breakdown, agg)
 
     def _compiled(self, code: str):
         key = transpiler.canonical_key(code)
@@ -253,7 +320,16 @@ class CodeEvaluator:
             # code (GIL released), so distinct candidates compile in
             # parallel across evaluate()'s thread pool
             policy = transpiler.transpile(code)
-            fn = jax.jit(self._mod.make_run_fn(self.workload, policy, self.cfg))
+            if self.suite is not None:
+                from fks_tpu.scenarios.robust import make_suite_eval
+                ev = make_suite_eval(
+                    self.suite,
+                    lambda _p, pod, nodes: policy(pod, nodes),
+                    self.cfg, engine=self.engine)
+                fn = lambda _s: ev(None)  # noqa: E731 — state0-call shape
+            else:
+                fn = jax.jit(
+                    self._mod.make_run_fn(self.workload, policy, self.cfg))
             with self._lock:
                 if key in self._cache:  # lost the race: reuse the winner
                     fn = self._cache[key]
@@ -379,7 +455,8 @@ class CodeEvaluator:
                 out.append(errors[i])
             else:
                 r = memo[key]
-                out.append(EvalRecord(code, r.score, r.error, r.result))
+                out.append(EvalRecord(code, r.score, r.error, r.result,
+                                      r.scenario_scores, r.aggregation))
         return out
 
     def scores(self, codes: Sequence[str]) -> np.ndarray:

@@ -82,6 +82,13 @@ class EvolutionConfig:
     # measured divergence bound (tools/divergence_audit.py).
     parity_sample: int = 0
     parity_tol: float = 1e-5
+    # scenario-suite robust fitness (fks_tpu.scenarios): name a registered
+    # suite ("" = off, single-trace fitness as before) and candidates are
+    # scored by the composite robust aggregate over every scenario —
+    # fault-injected variants included — evaluated in one vmapped call
+    scenario_suite: str = ""
+    robust_aggregation: str = "mean"  # mean | min | cvar
+    robust_cvar_alpha: float = 0.25
 
     llm: LLMSettings = dataclasses.field(default_factory=LLMSettings)
 
@@ -105,6 +112,9 @@ class EvolutionConfig:
             parametric_noise=fs.get("parametric_noise", 0.05),
             parity_sample=fs.get("parity_sample", 0),
             parity_tol=fs.get("parity_tol", 1e-5),
+            scenario_suite=fs.get("scenario_suite", ""),
+            robust_aggregation=fs.get("robust_aggregation", "mean"),
+            robust_cvar_alpha=fs.get("robust_cvar_alpha", 0.25),
             llm=LLMSettings(
                 api_key=lm.get("api_key", ""),
                 base_url=lm.get("base_url", LLMSettings.base_url),
@@ -149,6 +159,13 @@ class GenerationStats:
     parity_checked: int = 0
     parity_max_drift: float = 0.0
     parity_alerts: int = 0
+    # scenario-suite searches: which suite/aggregation scored this
+    # generation, and the champion's per-scenario breakdown (empty lists /
+    # "" on single-trace runs — the pre-scenario schema unchanged)
+    scenario_suite: str = ""
+    robust_aggregation: str = ""
+    best_scenario_scores: List[float] = dataclasses.field(
+        default_factory=list)
 
 
 def _percentile(sorted_desc: Sequence[float], q: float) -> float:
@@ -237,6 +254,7 @@ class FunSearch:
         # afterthought.)
         self._exact_eval: Optional[CodeEvaluator] = None
         self._exact_memo: dict = {}  # canonical AST key -> exact score
+        self._scenario_memo: dict = {}  # key -> per-scenario exact scores
         self.best_exact: Optional[float] = None
 
     # ----- population mechanics (reference funsearch_integration.py:174-215)
@@ -320,11 +338,7 @@ class FunSearch:
             # mid-run. The exact engine is integer/deterministic, so the
             # score is backend-independent.
             with self._exact_device():
-                if self._exact_eval is None:
-                    self._exact_eval = CodeEvaluator(
-                        self.evaluator.workload, self.evaluator.cfg,
-                        engine="exact")
-                exact = self._exact_eval.evaluate_one(code).score
+                exact = self._exact_evaluator().evaluate_one(code).score
         except Exception as e:  # noqa: BLE001 — a transient infrastructure
             # failure (evaluate_one catches candidate failures, but
             # evaluator construction itself can raise) must never kill the
@@ -341,6 +355,42 @@ class FunSearch:
             return score
         self._exact_memo[key] = exact
         return exact
+
+    def _exact_evaluator(self) -> CodeEvaluator:
+        """The lazily built exact rescoring evaluator. A scenario-suite
+        search rescores on the SAME suite (the persisted robust score must
+        be the exact-engine fold of the same scenarios the search ranked
+        on, not a single-trace number)."""
+        if self._exact_eval is None:
+            self._exact_eval = CodeEvaluator(
+                self.evaluator.workload, self.evaluator.cfg,
+                engine="exact", suite=self.evaluator.suite,
+                robust=self.evaluator.robust)
+        return self._exact_eval
+
+    def _scenario_breakdown(self, code: str) -> Optional[List[float]]:
+        """Per-scenario EXACT-engine scores for a champion (None without a
+        suite; memoized per canonical AST so champion saves and NEW-BEST
+        stats never re-simulate the same candidate)."""
+        if self.evaluator.suite is None:
+            return None
+        from fks_tpu.funsearch import transpiler
+        try:
+            key = transpiler.canonical_key(code)
+        except SyntaxError:
+            return None
+        if key not in self._scenario_memo:
+            try:
+                if self.evaluator.engine == "exact":
+                    rec = self.evaluator.evaluate_one(code)
+                else:
+                    with self._exact_device():
+                        rec = self._exact_evaluator().evaluate_one(code)
+            except Exception:  # noqa: BLE001 — transient infra failure:
+                # skip the breakdown this time, retry on the next call
+                return None
+            self._scenario_memo[key] = rec.scenario_scores
+        return self._scenario_memo[key]
 
     @staticmethod
     def _exact_device():
@@ -440,6 +490,19 @@ class FunSearch:
         # are the members whose fitness selection actually trusts)
         parity = self.sentinel.check(self.generation, self.population)
 
+        # scenario-suite bookkeeping: the champion's per-scenario breakdown
+        # rides the stats/ledger, and one robust_fitness metric per
+        # generation lands in the flight-recorder trail
+        suite = self.evaluator.suite
+        best_breakdown: List[float] = []
+        if suite is not None and self.best is not None:
+            best_breakdown = self._scenario_breakdown(self.best[0]) or []
+            self.recorder.metric(
+                "robust_fitness", generation=self.generation,
+                suite=suite.name, version=suite.version,
+                aggregation=self.evaluator.robust.aggregation,
+                scores=best_breakdown)
+
         scores = [s for _, s in self.population]  # descending post-_sort
         stats = GenerationStats(
             generation=self.generation,
@@ -457,7 +520,11 @@ class FunSearch:
             watchdog_flags=wd_flags,
             parity_checked=parity["checked"],
             parity_max_drift=parity["max_drift"],
-            parity_alerts=parity["alerts"])
+            parity_alerts=parity["alerts"],
+            scenario_suite=suite.name if suite is not None else "",
+            robust_aggregation=(self.evaluator.robust.aggregation
+                                if suite is not None else ""),
+            best_scenario_scores=best_breakdown)
         self.history.append(stats)
         # ledger first: the flight-recorder trail must be complete even if a
         # user on_generation callback raises
@@ -519,6 +586,14 @@ class FunSearch:
         if self.evaluator.engine != "exact":
             fields["search_score"] = score
             fields["search_engine"] = self.evaluator.engine
+        suite = self.evaluator.suite
+        if suite is not None:
+            fields["scenario_suite"] = suite.name
+            fields["suite_version"] = suite.version
+            fields["aggregation"] = self.evaluator.robust.aggregation
+            per = self._scenario_breakdown(code)
+            if per is not None:
+                fields["scenario_scores"] = dict(zip(suite.names, per))
         return fields
 
     def save_top_policies(self, directory: str, k: int = 5) -> str:
@@ -643,8 +718,18 @@ def run(workload, config: Optional[EvolutionConfig] = None,
     single best into ``out_dir``, reference: funsearch_integration.py:
     698-702) and the checkpoint — a long device run killed at the terminal
     must never lose its discoveries."""
-    fs = FunSearch(CodeEvaluator(workload, sim_config, engine=engine),
-                   config or EvolutionConfig(), backend, log,
+    config = config or EvolutionConfig()
+    suite = robust = None
+    if config.scenario_suite:
+        from fks_tpu.scenarios import RobustConfig, get_suite
+        suite = get_suite(config.scenario_suite, workload)
+        robust = RobustConfig(aggregation=config.robust_aggregation,
+                              cvar_alpha=config.robust_cvar_alpha)
+        log(f"scenario suite {suite.name} v{suite.version}: "
+            f"{len(suite)} scenarios, robust={robust.aggregation}")
+    fs = FunSearch(CodeEvaluator(workload, sim_config, engine=engine,
+                                 suite=suite, robust=robust),
+                   config, backend, log,
                    on_generation=on_generation, recorder=recorder)
     if checkpoint_path and os.path.exists(checkpoint_path):
         fs.restore(checkpoint_path)

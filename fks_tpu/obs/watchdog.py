@@ -123,9 +123,15 @@ class ParitySentinel:
         if self._ref is None:
             from fks_tpu.funsearch.backend import CodeEvaluator
 
+            # suite/robust ride along: a scenario-suite search's fitness is
+            # the robust aggregate, so the reference must fold the same
+            # scenarios or every check would alert on an apples-to-oranges
+            # comparison
             self._ref = CodeEvaluator(
                 self.evaluator.workload, self.evaluator.cfg,
-                engine="exact", use_vm=False)
+                engine="exact", use_vm=False,
+                suite=getattr(self.evaluator, "suite", None),
+                robust=getattr(self.evaluator, "robust", None))
         return self._ref
 
     @staticmethod

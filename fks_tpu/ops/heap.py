@@ -45,6 +45,12 @@ import numpy as np
 
 KIND_CREATE = 0
 KIND_DELETE = 1
+# Scenario fault events (fks_tpu.scenarios): cordon / uncordon a node.
+# They ride the same heap with pod column = node index; the retry-rule
+# scan below matches KIND_DELETE only, so fault events never become
+# retry anchors (the reference has no fault vocabulary to mirror).
+KIND_NODE_DOWN = 2
+KIND_NODE_UP = 3
 
 # column indices of EventHeap.data
 COL_TIME, COL_RANK, COL_KIND, COL_POD = 0, 1, 2, 3

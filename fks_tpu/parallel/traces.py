@@ -42,7 +42,8 @@ def _strip_ids(wl: Workload) -> Workload:
             **{f: getattr(wl.pods, f) for f in (
                 "cpu", "mem", "num_gpu", "gpu_milli", "creation_time",
                 "duration", "tie_rank", "pod_mask")},
-            "pod_ids": ()}))
+            "pod_ids": ()}),
+        faults=wl.faults)
 
 
 def stack_traces(workloads: Sequence[Workload], cfg: SimConfig,
@@ -64,6 +65,14 @@ def stack_traces(workloads: Sequence[Workload], cfg: SimConfig,
     if len(shapes) != 1:
         raise ValueError(f"workloads span multiple padded shapes {shapes}; "
                          "bucket them first (fks_tpu.data.synthetic)")
+    fshapes = {None if w.faults is None else w.faults.f_padded
+               for w in workloads}
+    if len(fshapes) != 1:
+        raise ValueError(
+            f"workloads mix fault-event padding {fshapes}; a stacked batch "
+            "needs one shared FaultEvents shape on every trace (or none) — "
+            "materialize suites via fks_tpu.scenarios, which pads faults "
+            "uniformly (fault-free scenarios get an all-masked timeline)")
     max_steps = max(cfg.resolve_max_steps(w.num_pods) for w in workloads)
     ktables = [snapshot_trigger_table(
         w.num_pods,

@@ -1,0 +1,31 @@
+"""Scenario suites: deterministic workload variants + robust fitness.
+
+The single-trace fitness the paper optimizes is a point estimate — a
+candidate can overfit one arrival pattern on a healthy cluster. This
+subsystem turns fitness into a robustness measure:
+
+- ``generator`` — seed-derived perturbations of a base workload
+  (arrival jitter, demand scaling, pod-mix shifts) plus fault injection
+  as precomputable NODE_DOWN/NODE_UP trace events with cordon semantics.
+- ``suite`` — named, versioned scenario suites (``default8``: base + 7
+  variants) materialized as same-shape workloads that stack under
+  ``parallel.traces``.
+- ``robust`` — evaluate one candidate (or a population) over the whole
+  suite in ONE vmapped device call (or sharded over a mesh) and fold the
+  per-scenario scores into a composite robust score (weighted mean /
+  min / CVaR-α).
+
+Wired into ``funsearch.backend.CodeEvaluator`` and ``funsearch.evolution``
+behind ``EvolutionConfig.scenario_suite`` so elites are selected by
+robustness rather than single-trace fitness.
+"""
+from fks_tpu.scenarios.generator import (  # noqa: F401
+    ScenarioSpec, fault_events_for, make_fault_events, perturb_workload,
+)
+from fks_tpu.scenarios.robust import (  # noqa: F401
+    AGGREGATIONS, RobustConfig, aggregate, make_sharded_suite_eval,
+    make_suite_eval,
+)
+from fks_tpu.scenarios.suite import (  # noqa: F401
+    SUITE_VERSION, ScenarioSuite, build_suite, get_suite, list_suites,
+)

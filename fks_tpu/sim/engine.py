@@ -39,13 +39,13 @@ import numpy as np
 from fks_tpu.data.entities import ClusterArrays, PodArrays, Workload
 from fks_tpu.ops.allocator import best_fit_gpus, first_fit_gpus
 from fks_tpu.ops.heap import (
-    KIND_CREATE, KIND_DELETE, EventHeap,
+    KIND_CREATE, KIND_DELETE, KIND_NODE_DOWN, KIND_NODE_UP, EventHeap,
     first_deletion_in_array_order, heap_from_events, heap_pop, heap_push,
 )
 from fks_tpu.sim.evaluator import max_snapshot_count, snapshot_trigger_table
 from fks_tpu.sim.guards import fitness_flags, sanitize_scores, score_flags
 from fks_tpu.sim.types import (
-    TRACE_CREATE, TRACE_DELETE, TRACE_RETRY,
+    TRACE_CREATE, TRACE_DELETE, TRACE_NODE_DOWN, TRACE_NODE_UP, TRACE_RETRY,
     NodeView, PodView, PolicyFn, SimResult, SimState, TraceBuffer, empty_trace,
 )
 
@@ -109,11 +109,27 @@ def initial_state(workload: Workload, cfg: SimConfig) -> SimState:
     c, p = workload.cluster, workload.pods
     n_real = p.num_pods
     pm = np.asarray(p.pod_mask)
-    heap = heap_from_events(
-        np.asarray(p.creation_time)[pm], np.asarray(p.tie_rank)[pm],
-        np.zeros(n_real, np.int8), np.nonzero(pm)[0].astype(np.int32),
-        capacity=p.p_padded,
-    )
+    times = np.asarray(p.creation_time)[pm]
+    ranks = np.asarray(p.tie_rank)[pm]
+    kinds = np.zeros(n_real, np.int32)
+    payload = np.nonzero(pm)[0].astype(np.int32)
+    capacity = p.p_padded
+    fe = workload.faults
+    if fe is not None:
+        # Fault events ride the same heap: payload column = node index,
+        # rank = (row index - F_pad) < 0, so at equal time every fault
+        # sorts BEFORE every pod event (tie_rank >= 0) and faults among
+        # themselves keep array order — the flat engine's argmin-first-
+        # index arbitration reproduces both orderings exactly.
+        fm = np.asarray(fe.mask)
+        fpad = int(fm.shape[0])
+        times = np.concatenate([times, np.asarray(fe.time)[fm]])
+        ranks = np.concatenate(
+            [ranks, np.nonzero(fm)[0].astype(np.int32) - fpad])
+        kinds = np.concatenate([kinds, np.asarray(fe.kind)[fm]])
+        payload = np.concatenate([payload, np.asarray(fe.node)[fm]])
+        capacity = p.p_padded + fpad
+    heap = heap_from_events(times, ranks, kinds, payload, capacity=capacity)
     n, g, pp = c.n_padded, c.g_padded, p.p_padded
     max_milli = int(np.asarray(p.gpu_milli).max(initial=0))
     hist_size = (cfg.wait_hist_size if cfg.wait_hist_size is not None
@@ -149,6 +165,7 @@ def initial_state(workload: Workload, cfg: SimConfig) -> SimState:
         numeric_flags=jnp.int32(0),
         trace=(empty_trace(cfg.resolve_trace_len(workload.num_pods), f)
                if cfg.decision_trace else None),
+        node_avail=None if fe is None else jnp.ones(n, bool),
     )
 
 
@@ -160,16 +177,23 @@ def _widest_int():
 
 def _trace_append(trace: TraceBuffer, *, active, create, is_del, was_waiting,
                   pod, node, scores, winner, pending,
-                  cpu_left, mem_left, gpu_left, gpu_milli_left) -> TraceBuffer:
+                  cpu_left, mem_left, gpu_left, gpu_milli_left,
+                  fault_down=None, fault_up=None) -> TraceBuffer:
     """Append one decision row (see TraceBuffer column docs). Shared by the
     exact and flat engines so the recorded vocabulary cannot drift between
     them. Self-masking: an inactive step, or a full buffer, appends via an
     out-of-range index whose scatter drops. Deletes record score/margin 0
     (the step's score vector is undefined on non-creation events under
-    ``cond_policy``), keeping row content engine-deterministic."""
+    ``cond_policy``), keeping row content engine-deterministic. Fault rows
+    (``fault_down``/``fault_up`` predicates, fault-carrying workloads only)
+    override the kind; their node column is the cordoned node and their
+    score/margin are 0 like deletes."""
     tlen = trace.data.shape[0]
     kind = jnp.where(is_del, TRACE_DELETE,
                      jnp.where(was_waiting, TRACE_RETRY, TRACE_CREATE))
+    if fault_down is not None:
+        kind = jnp.where(fault_down, TRACE_NODE_DOWN,
+                         jnp.where(fault_up, TRACE_NODE_UP, kind))
     wi = _widest_int()
     row = jnp.stack([
         kind.astype(jnp.int32), pod.astype(jnp.int32),
@@ -258,12 +282,24 @@ def build_step(workload: Workload, policy: PolicyFn, cfg: SimConfig,
     feat = jnp.stack([p.cpu, p.mem, p.num_gpu, p.gpu_milli, p.duration,
                       jnp.zeros_like(p.cpu), jnp.zeros_like(p.cpu),
                       jnp.zeros_like(p.cpu)], axis=-1).astype(jnp.int32)
+    # Python-static fault gating (like watchdog/decision_trace): fault-free
+    # workloads compile to the exact pre-scenario program.
+    has_faults = workload.faults is not None
 
     def step(s: SimState) -> SimState:
         active = lane_active(s, max_steps)
         h, (t, rk, kind, pod) = heap_pop(s.heap, pred=active)
         is_del = active & (kind == KIND_DELETE)
-        create = active & ~(kind == KIND_DELETE)
+        if has_faults:
+            # fault events (pod column = node index): flip the cordon bit,
+            # touch nothing else. Every pod-event mutation below is gated
+            # on is_del/create, so a fault step is a pure availability flip.
+            fault_down = active & (kind == KIND_NODE_DOWN)
+            fault_up = active & (kind == KIND_NODE_UP)
+            is_fault = fault_down | fault_up
+            create = active & (kind == KIND_CREATE)
+        else:
+            create = active & ~(kind == KIND_DELETE)
 
         pf = feat[pod]  # [8], one gather
         pcpu, pmem, pngpu, pmilli, pdur = pf[0], pf[1], pf[2], pf[3], pf[4]
@@ -287,6 +323,12 @@ def build_step(workload: Workload, policy: PolicyFn, cfg: SimConfig,
         sel_bits = ((bits >> g_iota) & 1).astype(jnp.int32)  # [G]
         gpu_milli_left = s.gpu_milli_left + oh_a[:, None] * pmilli * sel_bits[None, :]
 
+        # ---- FAULT: cordon/uncordon via one dense one-hot blend
+        node_avail = s.node_avail
+        if has_faults:
+            oh_f = n_iota == jnp.where(is_fault, pod, jnp.int32(n))
+            node_avail = jnp.where(oh_f, fault_up, node_avail)
+
         # ---- CREATION: score every node, strict argmax (main.py:101-111)
         pod_view = PodView(pcpu, pmem, pngpu, pmilli, pod_ct, pdur)
         node_view = _node_view(c, cpu_left, mem_left, gpu_left, gpu_milli_left)
@@ -301,7 +343,9 @@ def build_step(workload: Workload, policy: PolicyFn, cfg: SimConfig,
         if cfg.watchdog:
             numeric_flags = numeric_flags | score_flags(raw_scores, create)
             raw_scores = sanitize_scores(raw_scores)
-        scores = jnp.where(c.node_mask, raw_scores, 0)
+        # a cordoned (downed) node scores 0 — "cannot/refuse" — until NODE_UP
+        place_mask = c.node_mask & node_avail if has_faults else c.node_mask
+        scores = jnp.where(place_mask, raw_scores, 0)
         b = jnp.argmax(scores).astype(jnp.int32)
         placed = create & (scores[b] > 0)
 
@@ -370,7 +414,12 @@ def build_step(workload: Workload, policy: PolicyFn, cfg: SimConfig,
 
         # ---- evaluator bookkeeping (main.py:63-72, evaluator.py:55-67).
         # On alloc_fail the reference raises BEFORE record_event_processed.
+        # Fault events are control events, not scheduling events: they are
+        # excluded from events_processed (snapshot cadence), max_nodes, and
+        # the trace-step 'valid' accounting in BOTH engines.
         valid = active & ~alloc_fail
+        if has_faults:
+            valid = valid & ~is_fault
         events = s.events_processed + valid.astype(jnp.int32)
         fire = valid & (s.snap_idx < klen) & (
             events >= ktable[jnp.minimum(s.snap_idx, klen - 1)])
@@ -405,13 +454,19 @@ def build_step(workload: Workload, policy: PolicyFn, cfg: SimConfig,
 
         trace = s.trace
         if cfg.decision_trace:
+            tpod = pod
+            tnode = jnp.where(is_del, held_node, jnp.where(pl, b, -1))
+            fault_kw = {}
+            if has_faults:
+                tpod = jnp.where(is_fault, -1, tpod)
+                tnode = jnp.where(is_fault, pod, tnode)
+                fault_kw = dict(fault_down=fault_down, fault_up=fault_up)
             trace = _trace_append(
                 trace, active=active, create=create, is_del=is_del,
-                was_waiting=was_waiting, pod=pod,
-                node=jnp.where(is_del, held_node, jnp.where(pl, b, -1)),
+                was_waiting=was_waiting, pod=tpod, node=tnode,
                 scores=scores, winner=b, pending=heap3.size,
                 cpu_left=cpu_left, mem_left=mem_left, gpu_left=gpu_left,
-                gpu_milli_left=gpu_milli_left)
+                gpu_milli_left=gpu_milli_left, **fault_kw)
 
         return SimState(
             heap=heap3, cpu_left=cpu_left, mem_left=mem_left,
@@ -421,7 +476,7 @@ def build_step(workload: Workload, policy: PolicyFn, cfg: SimConfig,
             frag_sum=frag_sum, frag_count=frag_count, max_nodes=max_nodes,
             failed=s.failed | alloc_fail, steps=s.steps + active.astype(jnp.int32),
             violations=violations, numeric_flags=numeric_flags,
-            trace=trace,
+            trace=trace, node_avail=node_avail,
         )
 
     return step

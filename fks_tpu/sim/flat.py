@@ -76,6 +76,7 @@ import numpy as np
 
 from fks_tpu.data.entities import Workload
 from fks_tpu.ops.allocator import best_fit_gpus, first_fit_gpus
+from fks_tpu.ops.heap import KIND_NODE_UP
 from fks_tpu.sim.engine import (
     SimConfig, _audit, _node_view, _trace_append, _widest_int,
     finalize_fields, loop_tables, run_batched_lanes,
@@ -152,6 +153,11 @@ def initial_state(workload: Workload, cfg: SimConfig) -> FlatState:
         numeric_flags=jnp.int32(0),
         trace=(empty_trace(cfg.resolve_trace_len(workload.num_pods), f)
                if cfg.decision_trace else None),
+        fault_time=None if workload.faults is None else jnp.where(
+            jnp.asarray(workload.faults.mask),
+            jnp.asarray(workload.faults.time, jnp.int32), INF),
+        node_avail=(None if workload.faults is None
+                    else jnp.ones(c.n_padded, bool)),
     )
 
 
@@ -159,8 +165,13 @@ def lane_active(s: FlatState, max_steps: int):
     """Termination predicate (single source of truth for the loop cond and
     the step's self-masking). ``pending`` counts live slots, maintained
     incrementally so neither the cond nor the predicate needs a full
-    ev_time sweep."""
-    return (s.pending > 0) & ~s.failed & (s.steps < max_steps)
+    ev_time sweep. Unconsumed fault events keep the lane live too (the
+    exact engine's heap counts them the same way), so trailing NODE_UP
+    events drain in both engines."""
+    live = s.pending > 0
+    if s.fault_time is not None:
+        live = live | (jnp.min(s.fault_time, axis=-1) < INF)
+    return live & ~s.failed & (s.steps < max_steps)
 
 
 def build_step(workload: Workload, policy: PolicyFn, cfg: SimConfig,
@@ -206,6 +217,13 @@ def build_step(workload: Workload, policy: PolicyFn, cfg: SimConfig,
             duration=p.duration[perm], tie_rank=p.tie_rank[perm],
             pod_mask=p.pod_mask[perm])
 
+    # Python-static fault gating (like watchdog/decision_trace): fault-free
+    # workloads compile to the exact pre-scenario program.
+    has_faults = workload.faults is not None
+    if has_faults:
+        flt = jax.tree_util.tree_map(jnp.asarray, workload.faults)
+        f_iota = jnp.arange(flt.time.shape[0], dtype=jnp.int32)
+
     def step(s: FlatState) -> FlatState:
         active = lane_active(s, max_steps)
 
@@ -216,11 +234,24 @@ def build_step(workload: Workload, policy: PolicyFn, cfg: SimConfig,
         sidx = jnp.argmin(s.ev_time).astype(jnp.int32)
         next_del = jnp.min(jnp.where(s.aux >= 0, s.ev_time, INF))
 
+        if has_faults:
+            # fault-vs-pod arbitration: the earliest unconsumed fault wins
+            # ties against equal-time pod events (the exact engine gives
+            # faults negative tie ranks), and argmin's first-index rule
+            # among equal-time faults matches their heap rank order
+            fidx = jnp.argmin(s.fault_time).astype(jnp.int32)
+            take_fault = active & (s.fault_time[fidx] <= t)
+            fault_node = flt.node[fidx]
+            fault_is_up = flt.kind[fidx] == KIND_NODE_UP
+            pod_act = active & ~take_fault
+        else:
+            pod_act = active
+
         pf = feat[sidx]  # [8]
         pcpu, pmem, pngpu, pmilli, pdur = pf[0], pf[1], pf[2], pf[3], pf[4]
         aux_s = s.aux[sidx]
-        is_del = active & (aux_s >= 0)
-        create = active & (aux_s < 0)
+        is_del = pod_act & (aux_s >= 0)
+        create = pod_act & (aux_s < 0)
         was_waiting = aux_s == AUX_WAITING
 
         if packed:
@@ -242,6 +273,15 @@ def build_step(workload: Workload, policy: PolicyFn, cfg: SimConfig,
         sel_bits = ((held_bits >> g_iota) & 1).astype(jnp.int32)  # [G]
         gpu_milli_left = s.gpu_milli_left + oh_a[:, None] * pmilli * sel_bits[None, :]
 
+        # ---- FAULT: consume the event + flip the cordon bit (dense blends)
+        fault_time = s.fault_time
+        node_avail = s.node_avail
+        if has_faults:
+            fault_time = jnp.where((f_iota == fidx) & take_fault, INF,
+                                   s.fault_time)
+            oh_f = n_iota == jnp.where(take_fault, fault_node, jnp.int32(n))
+            node_avail = jnp.where(oh_f, fault_is_up, node_avail)
+
         # ---- CREATION: strict argmax placement (main.py:101-111).
         # creation_time == pop time for both fresh and retried pods (the
         # reference mutates pod.creation_time to the retry time, so at pop
@@ -259,7 +299,9 @@ def build_step(workload: Workload, policy: PolicyFn, cfg: SimConfig,
         if cfg.watchdog:
             numeric_flags = numeric_flags | score_flags(raw_scores, create)
             raw_scores = sanitize_scores(raw_scores)
-        scores = jnp.where(c.node_mask, raw_scores, 0)
+        # a cordoned (downed) node scores 0 — "cannot/refuse" — until NODE_UP
+        place_mask = c.node_mask & node_avail if has_faults else c.node_mask
+        scores = jnp.where(place_mask, raw_scores, 0)
         w = jnp.argmax(scores).astype(jnp.int32)
         placed = create & (scores[w] > 0)
 
@@ -315,7 +357,7 @@ def build_step(workload: Workload, policy: PolicyFn, cfg: SimConfig,
         else:
             enc = w
         new_aux = jnp.where(pl, enc, jnp.where(failp, AUX_WAITING, aux_s))
-        m = (q_iota == sidx) & active
+        m = (q_iota == sidx) & pod_act
         ev_time = jnp.where(m, new_t, s.ev_time)
         aux = jnp.where(m, new_aux, s.aux)
         aux_gpus = s.aux_gpus
@@ -326,8 +368,10 @@ def build_step(workload: Workload, policy: PolicyFn, cfg: SimConfig,
                      if cfg.track_ctime else s.pod_ctime)
         pending = s.pending - (is_del | dropped).astype(jnp.int32)
 
-        # ---- evaluator bookkeeping (identical to the exact engine)
-        valid = active & ~alloc_fail
+        # ---- evaluator bookkeeping (identical to the exact engine).
+        # Fault events are control events: excluded from events_processed,
+        # snapshots, and max_nodes (pod_act is active outside fault steps).
+        valid = pod_act & ~alloc_fail
         events = s.events_processed + valid.astype(jnp.int32)
         fire = valid & (s.snap_idx < klen) & (
             events >= ktable[jnp.minimum(s.snap_idx, klen - 1)])
@@ -360,13 +404,25 @@ def build_step(workload: Workload, policy: PolicyFn, cfg: SimConfig,
         if cfg.decision_trace:
             # pod column holds perm[sidx] — the ORIGINAL input-order pod id
             # — so rows align with the exact engine's without un-permuting.
+            # The pending column counts remaining fault events too, like
+            # the exact engine's heap size (align_traces compares exactly).
+            tpod = perm[sidx]
+            tnode = jnp.where(is_del, held_node, jnp.where(pl, w, -1))
+            trace_pending = pending
+            fault_kw = {}
+            if has_faults:
+                tpod = jnp.where(take_fault, -1, tpod)
+                tnode = jnp.where(take_fault, fault_node, tnode)
+                trace_pending = pending + jnp.sum(
+                    (fault_time < INF).astype(jnp.int32))
+                fault_kw = dict(fault_down=take_fault & ~fault_is_up,
+                                fault_up=take_fault & fault_is_up)
             trace = _trace_append(
                 trace, active=active, create=create, is_del=is_del,
-                was_waiting=was_waiting, pod=perm[sidx],
-                node=jnp.where(is_del, held_node, jnp.where(pl, w, -1)),
-                scores=scores, winner=w, pending=pending,
+                was_waiting=was_waiting, pod=tpod, node=tnode,
+                scores=scores, winner=w, pending=trace_pending,
                 cpu_left=cpu_left, mem_left=mem_left, gpu_left=gpu_left,
-                gpu_milli_left=gpu_milli_left)
+                gpu_milli_left=gpu_milli_left, **fault_kw)
 
         return FlatState(
             ev_time=ev_time, aux=aux, aux_gpus=aux_gpus, pending=pending,
@@ -377,6 +433,7 @@ def build_step(workload: Workload, policy: PolicyFn, cfg: SimConfig,
             max_nodes=max_nodes, failed=s.failed | alloc_fail,
             steps=s.steps + active.astype(jnp.int32), violations=violations,
             numeric_flags=numeric_flags, trace=trace,
+            fault_time=fault_time, node_avail=node_avail,
         )
 
     return step
@@ -435,7 +492,12 @@ def finalize(workload: Workload, cfg: SimConfig, s: FlatState) -> SimResult:
         max_nodes=s.max_nodes, failed=s.failed, violations=s.violations,
         numeric_flags=s.numeric_flags, trace=s.trace,
     )
-    return finalize_fields(workload, cfg, pending=s.pending > 0, s=view)
+    pend = s.pending > 0
+    if s.fault_time is not None:
+        # unconsumed fault events mean a truncated run, exactly as they
+        # would still sit in the exact engine's heap
+        pend = pend | (jnp.min(s.fault_time) < INF)
+    return finalize_fields(workload, cfg, pending=pend, s=view)
 
 
 def make_param_run_fn(workload: Workload, param_policy,

@@ -106,6 +106,11 @@ def _build_plan(workload: Workload, cfg: SimConfig) -> _Plan:
         raise ValueError("decision trace is not supported in the fused "
                          "kernel; replay with engine='exact' or 'flat' "
                          "(fks_tpu.obs.tracing / cli trace-diff)")
+    if workload.faults is not None:
+        raise ValueError("fault-injected workloads (fks_tpu.scenarios "
+                         "NODE_DOWN/NODE_UP events) are not supported in "
+                         "the fused kernel; evaluate scenario suites with "
+                         "engine='exact' or 'flat'")
     q = _round_up(pp, 128)
 
     pm = np.asarray(p.pod_mask)

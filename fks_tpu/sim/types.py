@@ -55,11 +55,15 @@ PolicyFn = Callable[[PodView, NodeView], Any]  # -> i32[N]
 
 
 # decision-trace event kinds (TraceBuffer COL_KIND values). RETRY marks a
-# creation attempt of a pod that already failed at least once.
+# creation attempt of a pod that already failed at least once; NODE_DOWN /
+# NODE_UP are scenario fault events (fks_tpu.scenarios — pod column -1,
+# node column the cordoned node, score/margin 0).
 TRACE_CREATE = 0
 TRACE_DELETE = 1
 TRACE_RETRY = 2
-TRACE_KIND_NAMES = ("CREATE", "DELETE", "RETRY")
+TRACE_NODE_DOWN = 3
+TRACE_NODE_UP = 4
+TRACE_KIND_NAMES = ("CREATE", "DELETE", "RETRY", "NODE_DOWN", "NODE_UP")
 
 
 class TraceBuffer(NamedTuple):
@@ -135,6 +139,9 @@ class SimState(NamedTuple):
     # pytree leaves, so the disabled path's carry structure — and therefore
     # the compiled program — is bit-identical to a build without tracing.
     trace: Any = None
+    # bool[N] node availability (cordon bit), or None unless the workload
+    # carries FaultEvents — same zero-leaf gating as ``trace``.
+    node_avail: Any = None
 
     # pod_state column indices
     COL_NODE = 0
@@ -193,6 +200,10 @@ class FlatState(NamedTuple):
     violations: Any
     numeric_flags: Any  # i32 watchdog bitmask (0 unless SimConfig.watchdog)
     trace: Any = None  # TraceBuffer or None (see SimState.trace)
+    # fault-event queue (None unless the workload carries FaultEvents):
+    # per-event times, INF once consumed; and the cordon bit per node.
+    fault_time: Any = None  # i32[F]
+    node_avail: Any = None  # bool[N]
 
 
 class SimResult(NamedTuple):

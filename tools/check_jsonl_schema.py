@@ -14,8 +14,9 @@ Beyond the generic ts/kind floor, records of KNOWN kinds (the watchdog /
 alert / parity / probe_failure vocabulary added with the numerics
 watchdog, plus the evolution ledger's generation records, plus the
 ``decision_trace``/``trace_diff`` records from fks_tpu.obs.tracing —
-whose embedded trace rows must carry a known CREATE/DELETE/RETRY event
-kind) are checked for their kind-specific required keys — a watchdog
+whose embedded trace rows must carry a known CREATE/DELETE/RETRY/
+NODE_DOWN/NODE_UP event kind, and the scenario-suite records from
+fks_tpu.scenarios) are checked for their kind-specific required keys — a watchdog
 event without a flag mask is as corrupt as a line without a timestamp.
 
 ``check_openmetrics(text)`` validates the ``cli export-metrics`` output:
@@ -64,10 +65,15 @@ EVENT_KIND_REQUIRED: Dict[str, Tuple[str, ...]] = {
 
 #: legal event kinds inside an embedded decision-trace row (must match
 #: fks_tpu.sim.types.TRACE_KIND_NAMES)
-TRACE_EVENT_KINDS = {"CREATE", "DELETE", "RETRY"}
+TRACE_EVENT_KINDS = {"CREATE", "DELETE", "RETRY", "NODE_DOWN", "NODE_UP"}
 METRIC_KIND_REQUIRED: Dict[str, Tuple[str, ...]] = {
     "generation": ("generation", "best_score"),
     "parity": ("generation", "checked", "max_drift"),
+    # scenario-suite vocabulary (fks_tpu.scenarios): the materialized
+    # suite summary (cli scenarios --run-dir) and the per-generation
+    # robust-fitness breakdown the evolution loop records
+    "scenario_suite": ("suite", "version", "scenarios"),
+    "robust_fitness": ("generation", "suite", "aggregation", "scores"),
 }
 
 #: an OpenMetrics sample line: name, optional {labels}, value, optional ts
