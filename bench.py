@@ -56,9 +56,14 @@ from the jax.monitoring listener — separately from
    when >1 device is visible. Never fails the bench; falls back to the
    CURRENT round's session-recorded code measurement.
 
-Stages run as ``python bench.py --stage parity|throughput|codetput``
+Stages run as ``python bench.py --stage parity|throughput|codetput|budget``
 (argv, not env, so a leaked variable can't turn the top-level run into a
-bare stage).
+bare stage). The ``budget`` stage is standalone (not part of the
+controller's headline pipeline): it measures the successive-halving
+eval-budget allocator (fks_tpu.funsearch.budget) — pruned-vs-full
+device seconds per generation at pop 64 x ``default8`` on the flat CPU
+engine — printing ``budget_speedup`` / ``budget_champion_match`` as its
+own JSON line, gateable with ``--gate``.
 
 Fallback contract (round 6): when the device probe fails, the headline
 ``value``/``vs_baseline`` stay 0.0 (nothing was measured THIS run), and
@@ -626,6 +631,119 @@ def stage_codetput() -> int:
     return 0
 
 
+def stage_budget(gate: str = "") -> int:
+    """CPU subprocess: successive-halving eval-budget headline — the same
+    generation of lowered FakeLLM candidates evaluated twice through the
+    batched VM suite tier (flat engine), once unbudgeted (everyone pays
+    ``default8`` x full trace) and once through the rung ladder (probe =
+    ``smoke3`` at a quarter of the trace event budget, top 1/eta
+    advancing). Prints one JSON line with ``budget_speedup`` (full /
+    pruned device seconds, steady-state — both paths warmed first so
+    compiles are excluded), ``budget_champion_match`` (1.0 when the
+    pruned run crowns the same champion as the full run — ties by score,
+    not index), and ``steady_state_recompiles`` (backend compiles
+    observed during the timed passes; nonzero means a rung broke the
+    compile-once-per-bucket contract)."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    from fks_tpu.data.synthetic import synthetic_workload
+    from fks_tpu.funsearch import vm
+    from fks_tpu.funsearch.backend import CodeEvaluator
+    from fks_tpu.funsearch.budget import BudgetConfig
+    from fks_tpu.obs import CompileWatcher
+    from fks_tpu.scenarios import get_suite
+    from fks_tpu.scenarios.robust import RobustConfig
+    from fks_tpu.sim.engine import SimConfig
+
+    global _RECORDER
+    _RECORDER = _controller_recorder()
+    watcher = CompileWatcher().install()
+    pop = int(os.environ.get("FKS_BENCH_BUDGET_POP", "64"))
+    eta = int(os.environ.get("FKS_BENCH_BUDGET_ETA", "4"))
+    # small synthetic workload: the stage times a RATIO on one shape, so
+    # it doesn't need the 8152-pod trace's wall time to make its point.
+    # 200 pods, not fewer: tiny pod streams tie fake candidates' scores
+    # so heavily that probe ranking degenerates to noise
+    wl = synthetic_workload(8, 200, seed=3)
+    cfg = SimConfig(max_steps=4 * wl.num_pods, track_ctime=False)
+    suite = get_suite("default8", wl)
+    robust = RobustConfig()
+    budget = BudgetConfig(schedule="halving", eta=eta,
+                          probe_suite="smoke3",
+                          probe_steps=max(1, cfg.max_steps // 4))
+    progs, _ = vm.lower_fake_candidates(
+        wl.cluster.n_padded, wl.cluster.g_padded, pop, capacity=256)
+    if len(progs) < pop:
+        log(f"only {len(progs)} VM-able candidates (need {pop})")
+        return 1
+    codes = [f"bench_budget_{i}" for i in range(pop)]
+    log(f"budget stage: pop={pop} eta={eta} "
+        f"probe=smoke3@{budget.probe_steps} steps, full=default8")
+
+    full = CodeEvaluator(wl, cfg, engine="flat", suite=suite,
+                         robust=robust, vm_batch=True)
+    pruned = CodeEvaluator(wl, cfg, engine="flat", suite=suite,
+                           robust=robust, budget=budget)
+
+    # warm both paths: compiles land here, not in the timed passes
+    t0 = time.perf_counter()
+    full._run_vm_batch(progs)
+    pruned._run_vm_batch_budget(progs, codes)
+    log(f"warm-up (compile+run, both paths): "
+        f"{time.perf_counter() - t0:.1f}s; XLA backend compile "
+        f"{watcher.backend_compile_seconds:.1f}s "
+        f"({watcher.backend_compile_count} programs)")
+    compiles_warm = watcher.backend_compile_count
+
+    t0 = time.perf_counter()
+    results_full = full._run_vm_batch(progs)
+    full_s = time.perf_counter() - t0
+    full_scores = np.array(
+        [full._record_suite(codes[i], results_full[i]).score
+         for i in range(pop)])
+
+    t0 = time.perf_counter()
+    recs = pruned._run_vm_batch_budget(progs, codes)
+    pruned_s = time.perf_counter() - t0
+    rung_dev_s = sum(r["device_seconds"] for r in pruned.last_budget_stats)
+    n_pruned = sum(r["entered"] - r["survived"]
+                   for r in pruned.last_budget_stats)
+    recompiles = watcher.backend_compile_count - compiles_warm
+
+    # champion parity by SCORE (fake candidates tie often; a different
+    # index with the same full-suite fitness is still a match)
+    champ_budget = int(np.argmax([r.score for r in recs]))
+    match = float(abs(full_scores[champ_budget] - full_scores.max()) <= 1e-9)
+    log(f"steady-state: full {full_s:.3f}s vs pruned {pruned_s:.3f}s "
+        f"({n_pruned}/{pop} pruned at rung 0); champion match {match}; "
+        f"recompiles in timed passes: {recompiles}")
+
+    payload = {
+        "budget_speedup": round(full_s / pruned_s, 3),
+        "device_seconds_full": round(full_s, 4),
+        "device_seconds_pruned": round(pruned_s, 4),
+        "budget_champion_match": match,
+        "population": pop,
+        "pruned_candidates": n_pruned,
+        "rung_device_seconds": round(rung_dev_s, 4),
+        "steady_state_recompiles": recompiles,
+        "backend_compiles": watcher.backend_compile_count,
+        "compile_seconds": round(watcher.backend_compile_seconds, 3),
+        **budget.describe(),
+    }
+    _record("metric", "bench_stage", payload, stage="budget",
+            platform="cpu")
+    rc = 0
+    if gate:
+        rc = _gate(gate, payload)
+    _record("finish", "ok")
+    _record("close")
+    print(json.dumps(payload))
+    return rc
+
+
 # ------------------------------------------------------------ controller
 
 
@@ -711,6 +829,11 @@ def main():
         return stage_throughput(pop, chunk, reps, engine)
     if stage == "codetput":
         return stage_codetput()
+    if stage == "budget":
+        # standalone headline for the eval-budget allocator; honors
+        # --gate itself (it prints its own JSON line, not the
+        # controller's)
+        return stage_budget(gate)
 
     # controller (hard deadline so the driver always gets the JSON line;
     # every stage/probe timeout below is clamped to the remaining budget)

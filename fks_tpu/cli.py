@@ -311,6 +311,14 @@ def cmd_evolve(args):
         cfg.scenario_suite = args.suite
     if args.robust_agg is not None:
         cfg.robust_aggregation = args.robust_agg
+    if args.budget is not None:
+        cfg.budget_schedule = args.budget
+    if args.budget_eta is not None:
+        cfg.budget_eta = args.budget_eta
+    if args.probe_suite is not None:
+        cfg.probe_suite = args.probe_suite
+    if args.probe_steps is not None:
+        cfg.probe_steps = args.probe_steps
     backend = FakeLLM(seed=cfg.seed) if args.fake_llm else None
     if backend is None and not cfg.llm.api_key:
         print("no API key in config; use --fake-llm for hermetic runs",
@@ -793,6 +801,20 @@ def main(argv=None) -> int:
                    help="how per-scenario scores fold into the robust "
                         "score (default mean; cvar = mean of the worst "
                         "quarter)")
+    e.add_argument("--budget", choices=("none", "halving"), default=None,
+                   help="eval-budget allocation over the suite "
+                        "(fks_tpu.funsearch.budget): 'halving' probes the "
+                        "whole generation cheaply, then only the top "
+                        "1/eta advance to the full suite (requires "
+                        "--suite; champion parity is sentinel-audited)")
+    e.add_argument("--budget-eta", type=int, default=None,
+                   help="survivor fraction denominator for --budget "
+                        "halving (default 2: keep the top half)")
+    e.add_argument("--probe-suite", default=None,
+                   help="probe-rung suite name (default smoke3)")
+    e.add_argument("--probe-steps", type=int, default=None,
+                   help="probe-rung event budget (truncated trace "
+                        "prefix; 0 = full trace on the probe suite)")
     e.set_defaults(fn=cmd_evolve)
 
     sc = sub.add_parser("scale", help="synthetic scale run + throughput",

@@ -4,6 +4,9 @@ TPU-native counterpart of the reference ``funsearch/`` package
 (reference: funsearch/safe_execution.py + funsearch/funsearch_integration.py).
 """
 from fks_tpu.funsearch.backend import CodeEvaluator, EvalRecord
+from fks_tpu.funsearch.budget import (
+    BudgetConfig, BudgetedSuiteEval, probe_sim_config,
+)
 from fks_tpu.funsearch.device_evolution import (
     DeviceGenStats, ParametricEvolution,
 )
@@ -20,8 +23,9 @@ from fks_tpu.funsearch.template import build_prompt, fill_template, seed_policie
 from fks_tpu.funsearch.transpiler import TranspileError, canonical_key, transpile
 
 __all__ = [
+    "BudgetConfig", "BudgetedSuiteEval",
     "CandidateGenerator", "CodeEvaluator", "DeviceGenStats", "EvalRecord",
-    "EvolutionConfig",
+    "EvolutionConfig", "probe_sim_config",
     "FakeLLM", "FunSearch", "GenerationStats", "LLMSettings", "OpenAIBackend",
     "ParametricEvolution",
     "ScalarGPU", "ScalarNode", "ScalarPod", "TranspileError", "build_prompt",

@@ -46,6 +46,7 @@ from fks_tpu.data.entities import Workload
 from fks_tpu.funsearch import transpiler, vm
 from fks_tpu.sim.engine import SimConfig
 from fks_tpu.sim.types import SimResult
+from fks_tpu.utils.segments import validate_seg_steps
 
 
 @dataclasses.dataclass
@@ -60,6 +61,11 @@ class EvalRecord:
     # composite ``score`` was folded from, and the fold that produced it
     scenario_scores: Optional[List[float]] = None
     aggregation: Optional[str] = None
+    # budget-allocated evaluations (fks_tpu.funsearch.budget): the rung
+    # this record's fidelity comes from — 0 = pruned at the probe rung
+    # (score is the capped probe aggregate), 1 = survived to the full
+    # suite; None on unbudgeted evaluations
+    budget_rung: Optional[int] = None
 
     @property
     def ok(self) -> bool:
@@ -80,7 +86,7 @@ class CodeEvaluator:
     def __init__(self, workload: Workload, cfg: SimConfig = SimConfig(),
                  max_workers: Optional[int] = None, use_vm: bool = True,
                  engine: str = "exact", vm_batch: Optional[bool] = None,
-                 mesh=None, suite=None, robust=None):
+                 mesh=None, suite=None, robust=None, budget=None):
         from fks_tpu.sim import get_engine
 
         self.workload = workload
@@ -96,6 +102,24 @@ class CodeEvaluator:
         # requires the exact or flat engine.
         self.suite = suite
         self.robust = robust
+        # Eval-budget allocation (fks_tpu.funsearch.budget): with an
+        # enabled BudgetConfig the batched VM tier spends its device
+        # budget in rungs — the whole generation on a cheap probe, only
+        # the surviving 1/eta fraction on the full suite.
+        self.budget = budget if (budget is not None
+                                 and budget.enabled) else None
+        self.last_budget_stats: List[dict] = []  # per-rung, last evaluate()
+        if self.budget is not None and engine == "fused":
+            raise ValueError(
+                "budget-pruned rungs (fks_tpu.funsearch.budget) are not "
+                "supported in the fused kernel (probe scoring and fault "
+                "suites have no Pallas lowering); run budget-allocated "
+                "suite evaluation with engine='exact' or 'flat'")
+        if self.budget is not None and suite is None:
+            raise ValueError(
+                "budget allocation prunes between a probe suite and the "
+                "full suite, so it requires suite mode; set "
+                "EvolutionConfig.scenario_suite (cli evolve --suite)")
         if suite is not None:
             if engine == "fused":
                 raise ValueError(
@@ -119,6 +143,7 @@ class CodeEvaluator:
         self._vm_run = None  # lazily built shared engine program
         self._vm_pop_run = None  # lazily built POPULATION engine program
         self._vm_mesh_run = None  # lazily built SHARDED population program
+        self._budget_eval = None  # lazily built rung ladder (budget mode)
         self.vm_batch_count = 0  # observability: batched VM launches
         # Mesh-sharded batched tier: with a >1-device mesh each device
         # interprets its shard of the stacked generation
@@ -137,7 +162,12 @@ class CodeEvaluator:
         # which only the batched tier can use.
         if vm_batch is None:
             vm_batch = (jax.default_backend() != "cpu"
-                        or self._n_shards > 1)
+                        or self._n_shards > 1
+                        # the budget rung ladder IS a batched-tier
+                        # construct (one stacked launch per rung); with
+                        # an enabled budget the pruning win dominates the
+                        # CPU switch-fan-out loss, so batch there too
+                        or self.budget is not None)
         self.vm_batch = vm_batch
         # Bounded device-call length for the batched tier (flat engine
         # only): the axon TPU tunnel kills single device executions over
@@ -145,18 +175,8 @@ class CodeEvaluator:
         # can exceed that regardless of population size. 0 disables.
         seg = os.environ.get("FKS_VM_SEG_STEPS")
         if seg is not None:
-            try:
-                seg_val = int(seg)
-            except ValueError:
-                raise ValueError(
-                    f"FKS_VM_SEG_STEPS must be an integer (segment length "
-                    f"in events; 0 disables segmentation), got {seg!r}"
-                ) from None
-            if seg_val < 0:
-                raise ValueError(
-                    f"FKS_VM_SEG_STEPS must be >= 0 (0 disables "
-                    f"segmentation), got {seg_val}")
-            self.vm_seg_steps = seg_val
+            self.vm_seg_steps = validate_seg_steps(
+                seg, source="FKS_VM_SEG_STEPS")
         else:
             self.vm_seg_steps = (
                 4096 if jax.default_backend() == "tpu" else 0)
@@ -279,6 +299,77 @@ class CodeEvaluator:
             self.vm_count += len(progs)
         return [jax.tree_util.tree_map(lambda x, i=i: x[i], result)
                 for i in range(len(progs))]
+
+    # ----- budgeted batched tier: probe rung -> survivors -> full rung
+
+    def _budget_ladder(self):
+        """The lazily built rung ladder (fks_tpu.funsearch.budget). The
+        full rung reuses THIS evaluator's population suite program, so
+        budget mode adds one compiled program (the probe), not two."""
+        if self._budget_eval is None:
+            from fks_tpu.funsearch.budget import BudgetedSuiteEval
+            self._budget_eval = BudgetedSuiteEval(
+                self.workload, self.cfg, self.budget, self.robust,
+                full_runner=lambda stacked: self._vm_pop_runner()(
+                    stacked, self.state0),
+                engine=self.engine, n_shards=self._n_shards,
+                segment_counter=lambda: self.segments_dispatched)
+        return self._budget_eval
+
+    def _budget_active(self, n: int) -> bool:
+        """Budget pruning engages only when it would actually prune: an
+        enabled schedule, suite mode, and a batch big enough that the
+        survivor count is a strict subset."""
+        return (self.budget is not None and self.suite is not None
+                and n >= 2 and self.budget.survivors(n) < n)
+
+    def _run_vm_batch_budget(self, progs: List[vm.VMProgram],
+                             codes: List[str]) -> List[EvalRecord]:
+        """Budgeted generation evaluation: every rung is one device
+        launch on a bucketed static shape (fks_tpu.funsearch.budget).
+        Survivors get full-fidelity suite records (budget_rung=1); the
+        pruned keep their probe aggregate capped below the worst
+        survivor's full score (budget_rung=0), so pruning can demote but
+        never promote — the generation champion is always a survivor,
+        and ParitySentinel.check_champion audits the rest."""
+        outcome = self._budget_ladder().run(progs)
+        with self._lock:
+            self.vm_batch_count += len(outcome.rungs)
+            self.vm_count += len(progs)
+        records: List[Optional[EvalRecord]] = [None] * len(progs)
+        floor = None
+        for i in outcome.survivor_indices:
+            rec = self._record_suite(codes[i], outcome.results[i])
+            rec.budget_rung = 1
+            records[i] = rec
+            floor = rec.score if floor is None else min(floor, rec.score)
+        for i, pruned in enumerate(outcome.pruned):
+            if pruned:
+                records[i] = self._record_pruned(
+                    codes[i], outcome.results[i],
+                    outcome.probe_scores[i], floor or 0.0)
+        self.last_budget_stats = [r.asdict() for r in outcome.rungs]
+        return records
+
+    def _record_pruned(self, code: str, result: SimResult,
+                       probe_score: float, floor: float) -> EvalRecord:
+        """Probe-rung record for a pruned candidate. Truncation is the
+        probe's DESIGN (probe_steps stops the run early), so unlike
+        ``_record_suite`` an all-truncated probe is not an error — only
+        an all-scenarios failure is. The score is the probe robust
+        aggregate capped at the worst survivor's full-suite score: probe
+        fitness is biased high (partial-prefix scoring ignores the
+        unassigned-pods gate), and an uncapped probe score could crown a
+        pruned dud over a fully-evaluated survivor."""
+        per = np.asarray(result.policy_score, np.float64)
+        breakdown = [float(x) for x in per]
+        agg = self.robust.aggregation
+        if bool(np.asarray(result.failed).all()):
+            return EvalRecord(code, 0.0, "gpu allocation aborted "
+                              "(all scenarios)", result, breakdown, agg,
+                              budget_rung=0)
+        return EvalRecord(code, float(min(probe_score, floor)), None,
+                          result, breakdown, agg, budget_rung=0)
 
     def _record(self, code: str, result: SimResult) -> EvalRecord:
         if self.suite is not None:
@@ -411,12 +502,21 @@ class CodeEvaluator:
             general = dict(unique)
 
         batch_served = 0
+        self.last_budget_stats = []
         if vm_progs:
             vm_keys = list(vm_progs)
             try:
-                results = self._run_vm_batch([vm_progs[k] for k in vm_keys])
-                for key, res in zip(vm_keys, results):
-                    memo[key] = self._record(unique[key], res)
+                if self._budget_active(len(vm_keys)):
+                    recs = self._run_vm_batch_budget(
+                        [vm_progs[k] for k in vm_keys],
+                        [unique[k] for k in vm_keys])
+                    for key, rec in zip(vm_keys, recs):
+                        memo[key] = rec
+                else:
+                    results = self._run_vm_batch(
+                        [vm_progs[k] for k in vm_keys])
+                    for key, res in zip(vm_keys, results):
+                        memo[key] = self._record(unique[key], res)
                 batch_served = len(vm_keys)
             except Exception as e:  # noqa: BLE001 — batch failed:
                 # per-candidate fallback still produces scores, but say
@@ -447,6 +547,8 @@ class CodeEvaluator:
             "vm_batch_lanes": batch_served,
             "fallback_lanes": len(jit_only) + len(general),
             "segments": self.segments_dispatched - seg0,
+            "budget_pruned": sum(r["entered"] - r["survived"]
+                                 for r in self.last_budget_stats),
         }
 
         out = []
@@ -456,7 +558,8 @@ class CodeEvaluator:
             else:
                 r = memo[key]
                 out.append(EvalRecord(code, r.score, r.error, r.result,
-                                      r.scenario_scores, r.aggregation))
+                                      r.scenario_scores, r.aggregation,
+                                      r.budget_rung))
         return out
 
     def scores(self, codes: Sequence[str]) -> np.ndarray:

@@ -89,6 +89,15 @@ class EvolutionConfig:
     scenario_suite: str = ""
     robust_aggregation: str = "mean"  # mean | min | cvar
     robust_cvar_alpha: float = 0.25
+    # successive-halving eval-budget allocation (fks_tpu.funsearch.budget;
+    # requires a scenario_suite): score the whole generation on a cheap
+    # probe rung — the probe_suite and/or a probe_steps-truncated trace
+    # prefix — and advance only the top 1/budget_eta fraction to the full
+    # suite. "none" = full-fidelity evaluation for every candidate.
+    budget_schedule: str = "none"  # none | halving
+    budget_eta: int = 2
+    probe_suite: str = "smoke3"
+    probe_steps: int = 0  # probe event budget; 0 = full trace on the probe
 
     llm: LLMSettings = dataclasses.field(default_factory=LLMSettings)
 
@@ -115,6 +124,10 @@ class EvolutionConfig:
             scenario_suite=fs.get("scenario_suite", ""),
             robust_aggregation=fs.get("robust_aggregation", "mean"),
             robust_cvar_alpha=fs.get("robust_cvar_alpha", 0.25),
+            budget_schedule=fs.get("budget_schedule", "none"),
+            budget_eta=fs.get("budget_eta", 2),
+            probe_suite=fs.get("probe_suite", "smoke3"),
+            probe_steps=fs.get("probe_steps", 0),
             llm=LLMSettings(
                 api_key=lm.get("api_key", ""),
                 base_url=lm.get("base_url", LLMSettings.base_url),
@@ -166,6 +179,13 @@ class GenerationStats:
     robust_aggregation: str = ""
     best_scenario_scores: List[float] = dataclasses.field(
         default_factory=list)
+    # eval-budget allocation (fks_tpu.funsearch.budget): how many LLM
+    # candidates the probe rung pruned away from the full suite this
+    # generation, and the total device wall across all rungs (the
+    # per-rung breakdown rides kind="budget_rung" metric records; 0/0.0
+    # on unbudgeted runs — the pre-budget schema unchanged)
+    budget_pruned: int = 0
+    budget_device_seconds: float = 0.0
 
 
 def _percentile(sorted_desc: Sequence[float], q: float) -> float:
@@ -451,6 +471,21 @@ class FunSearch:
         eval_s = t.seconds
         sandbox_failed, transpile_failed = _failure_counts(records)
 
+        # eval-budget ledger: one budget_rung metric per rung (entered /
+        # survived / device-seconds / segment count), then the champion
+        # audit — pruning may never change who wins a generation, only
+        # how cheaply, and a violated audit alerts into the same exit-3
+        # policy as fitness-drift parity alerts
+        budget_rungs = list(
+            getattr(self.evaluator, "last_budget_stats", []) or [])
+        budget_alerts = 0
+        for rung in budget_rungs:
+            self.recorder.metric(
+                "budget_rung", generation=self.generation, **rung)
+        if budget_rungs:
+            budget_alerts = self.sentinel.check_champion(
+                self.generation, records)["alerts"]
+
         # numerics watchdog: one event per generation carrying the OR of
         # every evaluation's flag mask (always 0 when SimConfig.watchdog
         # is off — the guards are compiled out)
@@ -520,11 +555,15 @@ class FunSearch:
             watchdog_flags=wd_flags,
             parity_checked=parity["checked"],
             parity_max_drift=parity["max_drift"],
-            parity_alerts=parity["alerts"],
+            parity_alerts=parity["alerts"] + budget_alerts,
             scenario_suite=suite.name if suite is not None else "",
             robust_aggregation=(self.evaluator.robust.aggregation
                                 if suite is not None else ""),
-            best_scenario_scores=best_breakdown)
+            best_scenario_scores=best_breakdown,
+            budget_pruned=sum(r["entered"] - r["survived"]
+                              for r in budget_rungs),
+            budget_device_seconds=round(sum(r["device_seconds"]
+                                            for r in budget_rungs), 6))
         self.history.append(stats)
         # ledger first: the flight-recorder trail must be complete even if a
         # user on_generation callback raises
@@ -719,7 +758,7 @@ def run(workload, config: Optional[EvolutionConfig] = None,
     698-702) and the checkpoint — a long device run killed at the terminal
     must never lose its discoveries."""
     config = config or EvolutionConfig()
-    suite = robust = None
+    suite = robust = budget = None
     if config.scenario_suite:
         from fks_tpu.scenarios import RobustConfig, get_suite
         suite = get_suite(config.scenario_suite, workload)
@@ -727,8 +766,18 @@ def run(workload, config: Optional[EvolutionConfig] = None,
                               cvar_alpha=config.robust_cvar_alpha)
         log(f"scenario suite {suite.name} v{suite.version}: "
             f"{len(suite)} scenarios, robust={robust.aggregation}")
+    if config.budget_schedule != "none":
+        from fks_tpu.funsearch.budget import BudgetConfig
+        budget = BudgetConfig(schedule=config.budget_schedule,
+                              eta=config.budget_eta,
+                              probe_suite=config.probe_suite,
+                              probe_steps=config.probe_steps)
+        log(f"eval budget {budget.schedule}: probe {budget.probe_suite}"
+            + (f" @{budget.probe_steps} events" if budget.probe_steps
+               else "")
+            + f", top 1/{budget.eta} advance to the full suite")
     fs = FunSearch(CodeEvaluator(workload, sim_config, engine=engine,
-                                 suite=suite, robust=robust),
+                                 suite=suite, robust=robust, budget=budget),
                    config, backend, log,
                    on_generation=on_generation, recorder=recorder)
     if checkpoint_path and os.path.exists(checkpoint_path):

@@ -52,6 +52,11 @@ DEFAULT_THRESHOLDS: Dict[str, Threshold] = {
     "parity_max_drift": Threshold(higher_is_better=False, abs_tol=1e-5),
     "watchdog_violations": Threshold(higher_is_better=False, abs_tol=0.0),
     "alerts": Threshold(higher_is_better=False, abs_tol=0.0),
+    # eval-budget allocation (bench stage_budget): pruned-vs-full device
+    # seconds per generation must not regress by more than 10%, and the
+    # pruned run's champion must keep matching the full run's (0/1 flag)
+    "budget_speedup": Threshold(higher_is_better=True, rel=0.10),
+    "budget_champion_match": Threshold(higher_is_better=True, abs_tol=0.0),
 }
 
 
@@ -83,7 +88,8 @@ def _from_run_dir(run_dir: str) -> Dict[str, float]:
     for m in metrics:
         if m.get("kind") != "bench_stage":
             continue
-        for key in ("evals_per_sec", "code_evals_per_sec"):
+        for key in ("evals_per_sec", "code_evals_per_sec",
+                    "budget_speedup", "budget_champion_match"):
             v = _num(m.get(key))
             if v is not None:
                 out[key] = max(out.get(key, 0.0), v)
@@ -116,7 +122,8 @@ def _from_jsonl(path: str) -> Dict[str, float]:
     def take(rec: Dict[str, Any]) -> None:
         for key in ("evals_per_sec", "code_evals_per_sec",
                     "compile_seconds", "best_score", "median_score",
-                    "parity_max_drift"):
+                    "parity_max_drift", "budget_speedup",
+                    "budget_champion_match"):
             v = _num(rec.get(key))
             if v is None:
                 continue

@@ -50,6 +50,10 @@ GENERATION_GAUGES = (
      "unique XLA programs built"),
     ("generation_vm_candidates", "vm_candidates",
      "candidates served by the VM tier"),
+    ("generation_budget_pruned", "budget_pruned",
+     "candidates pruned by the eval-budget probe rung"),
+    ("generation_budget_device_seconds", "budget_device_seconds",
+     "device wall seconds across all budget rungs"),
 )
 
 
@@ -135,10 +139,30 @@ def to_openmetrics(run_dir: str) -> str:
             "candidates parity-checked this generation").add(
             p.get("checked"), run_id=run_id, generation=gen)
 
+    # eval-budget rung ladder (fks_tpu.funsearch.budget): per-rung entered/
+    # survived/cost gauges, labeled by generation and rung index
+    for b in (m for m in metrics if m.get("kind") == "budget_rung"):
+        gen, rung = b.get("generation"), b.get("rung")
+        fam("budget_rung_entered", "gauge",
+            "candidates entering this budget rung").add(
+            b.get("entered"), run_id=run_id, generation=gen, rung=rung)
+        fam("budget_rung_survived", "gauge",
+            "candidates surviving this budget rung").add(
+            b.get("survived"), run_id=run_id, generation=gen, rung=rung)
+        fam("budget_rung_device_seconds", "gauge",
+            "device wall seconds spent in this budget rung").add(
+            b.get("device_seconds"), run_id=run_id, generation=gen,
+            rung=rung)
+        if "segments" in b:
+            fam("budget_rung_segments", "gauge",
+                "segmented-runner dispatches in this budget rung").add(
+                b.get("segments"), run_id=run_id, generation=gen, rung=rung)
+
     for s in (m for m in metrics if m.get("kind") == "bench_stage"):
         stage = s.get("stage", "?")
         for key in ("evals_per_sec", "code_evals_per_sec", "compile_seconds",
-                    "first_call_seconds", "steady_state_seconds", "value"):
+                    "first_call_seconds", "steady_state_seconds", "value",
+                    "budget_speedup", "budget_champion_match"):
             if key in s:
                 fam(f"bench_{key}", "gauge",
                     f"bench stage {key}").add(

@@ -166,6 +166,31 @@ def _infra_section(events: List[Dict[str, Any]]) -> List[str]:
     return lines
 
 
+def _budget_section(metrics: List[Dict[str, Any]]) -> List[str]:
+    """Eval-budget rung ladder (fks_tpu.funsearch.budget): one row per
+    rung per generation — who entered, who survived to the next rung,
+    device wall per rung — plus the total pruned-candidate count."""
+    rungs = [m for m in metrics if m.get("kind") == "budget_rung"]
+    if not rungs:
+        return []
+    rows = [{
+        "gen": r.get("generation"),
+        "rung": r.get("rung"),
+        "entered": r.get("entered"),
+        "survived": r.get("survived"),
+        "dev_s": _num(float(r.get("device_seconds", 0.0)), 3),
+        "segs": r.get("segments", 0),
+        "lanes": r.get("lanes", ""),
+    } for r in rungs]
+    pruned = sum(int(r.get("entered", 0)) - int(r.get("survived", 0))
+                 for r in rungs)
+    lines = [f"budget rungs: {len(rungs)} recorded, {pruned} candidates "
+             "pruned before the full suite"]
+    lines += _fmt_table(rows, ["gen", "rung", "entered", "survived",
+                               "dev_s", "segs", "lanes"])
+    return lines
+
+
 def _bench_section(metrics: List[Dict[str, Any]]) -> List[str]:
     stages = [m for m in metrics if m.get("kind") == "bench_stage"]
     lines = []
@@ -173,7 +198,9 @@ def _bench_section(metrics: List[Dict[str, Any]]) -> List[str]:
         parts = [f"bench stage {s.get('stage', '?')}:"]
         for k in ("evals_per_sec", "code_evals_per_sec", "compile_seconds",
                   "first_call_seconds", "steady_state_seconds",
-                  "cost_flops", "cost_bytes_accessed"):
+                  "cost_flops", "cost_bytes_accessed", "budget_speedup",
+                  "budget_champion_match", "device_seconds_full",
+                  "device_seconds_pruned"):
             if k in s:
                 parts.append(f"{k}={_num(float(s[k]), 3)}")
         lines.append(" ".join(parts))
@@ -226,8 +253,8 @@ def render_report(run_dir: str) -> str:
             lines.append(f"{key}: {meta[key]}")
     lines.extend(_trace_diff_lines(events))
     for section in (_infra_section(events), _generation_section(metrics),
-                    _bench_section(metrics), _compile_section(events),
-                    _span_section(events)):
+                    _budget_section(metrics), _bench_section(metrics),
+                    _compile_section(events), _span_section(events)):
         if section:
             lines.append("")
             lines.extend(section)

@@ -206,6 +206,68 @@ class ParitySentinel:
             self.recorder.event("alert", **alert_fields)
         return stats
 
+    def check_champion(self, generation: int, records) -> Dict[str, Any]:
+        """Budget-pruning champion audit (fks_tpu.funsearch.budget):
+        pruning may never change which candidate wins a generation, only
+        how cheaply. The pruned run's champion is by construction a
+        full-rung survivor; the only way it can be WRONG is a pruned
+        candidate whose full-fidelity score would have beaten it. Rescore
+        every pruned candidate plus the champion through the unpruned
+        exact reference and alert (``source="budget_champion"``, feeding
+        the CLI exit-3 policy) when any pruned candidate's reference
+        score exceeds the champion's by more than ``tol``. Bounded work:
+        at most candidates-per-generation exact rescores, memoized by
+        the reference's own compile cache. Runs regardless of
+        ``self.sample`` — the budget opt-in is the gate."""
+        stats = {"generation": int(generation), "checked": 0,
+                 "max_gap": 0.0, "alerts": 0}
+        pruned = [r for r in records
+                  if getattr(r, "budget_rung", None) == 0 and r.ok]
+        survivors = [r for r in records
+                     if getattr(r, "budget_rung", None) == 1 and r.ok]
+        if not pruned or not survivors:
+            return stats
+        champion = max(survivors, key=lambda r: r.score)
+        failed = 0
+        gaps: List[Tuple[float, str]] = []
+        with self._cpu_device():
+            ref = self._reference()
+            try:
+                champ_ref = float(ref.evaluate_one(champion.code).score)
+            except Exception:  # noqa: BLE001 — sentinel failures must
+                return stats   # never take down the search
+            for r in pruned:
+                try:
+                    rec = ref.evaluate_one(r.code)
+                except Exception:  # noqa: BLE001
+                    failed += 1
+                    continue
+                if not rec.ok:
+                    failed += 1
+                    continue
+                gaps.append((float(rec.score) - champ_ref, r.code))
+        self.checked += len(gaps) + 1
+        worst = max(gaps, key=lambda g: g[0]) if gaps else (0.0, "")
+        gap = max(0.0, worst[0])
+        stats.update(checked=len(gaps) + 1, max_gap=round(gap, 8),
+                     failed=failed)
+        self.recorder.metric("parity", {
+            "generation": int(generation), "checked": len(gaps) + 1,
+            "failed": failed, "max_drift": round(gap, 8),
+            "tol": self.tol, "source": "budget_champion"})
+        if gap > self.tol:
+            self.alerts += 1
+            self.max_drift = max(self.max_drift, gap)
+            stats["alerts"] = 1
+            self.recorder.event(
+                "alert", source="budget_champion",
+                generation=int(generation), max_drift=round(gap, 8),
+                tol=self.tol,
+                detail=f"budget pruning dropped a candidate whose exact "
+                       f"reference score beats the pruned run's champion "
+                       f"by {gap:.3g} (tol {self.tol:.3g})")
+        return stats
+
     def _diff_offender(self, code: str, generation: int) -> Optional[dict]:
         """Best-effort root-cause localization for an alert: trace-diff
         the worst offender's search-tier evaluation against the exact

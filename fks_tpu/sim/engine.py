@@ -91,6 +91,16 @@ class SimConfig:
     # None (zero pytree leaves) and the compiled program is identical.
     decision_trace: bool = False
     trace_len: Optional[int] = None  # trace rows; default resolve_max_steps
+    # probe scoring (fks_tpu.funsearch.budget): score a truncated prefix.
+    # The normal gate zeroes any run that still has pending events or
+    # unassigned pods — correct for full evaluations, useless for a budget
+    # probe that deliberately stops at ``probe_steps``. With probe_score
+    # the fitness is the utilization integral over the consumed prefix
+    # (still zeroed on failure / zero snapshots), and SimResult.truncated
+    # keeps reporting the truth. Python-static like ``watchdog``: the
+    # default-off path selects the same jnp.where gate expression as
+    # before, compiling the identical program.
+    probe_score: bool = False
 
     def resolve_max_steps(self, num_pods: int) -> int:
         if self.max_steps is not None:
@@ -550,9 +560,11 @@ def finalize_fields(workload: Workload, cfg: SimConfig, *, pending, s) -> SimRes
     truncated = pending & ~s.failed
     overall = jnp.sum(avg) / 4
     raw = jnp.clip(overall - jnp.minimum(jnp.asarray(0.1, f), frag_mean), 0.0, 1.0)
-    score = jnp.where(
-        (n_snap > 0) & all_assigned & ~s.failed & ~truncated, raw,
-        jnp.asarray(0, f))
+    if cfg.probe_score:
+        gate = (n_snap > 0) & ~s.failed
+    else:
+        gate = (n_snap > 0) & all_assigned & ~s.failed & ~truncated
+    score = jnp.where(gate, raw, jnp.asarray(0, f))
     scheduled = jnp.sum((s.assigned_node >= 0) & pod_mask, dtype=jnp.int32)
     numeric_flags = s.numeric_flags
     if cfg.watchdog:

@@ -83,6 +83,7 @@ from fks_tpu.sim.engine import (
 )
 from fks_tpu.sim.guards import sanitize_scores, score_flags
 from fks_tpu.sim.types import FlatState, PodView, PolicyFn, SimResult, empty_trace
+from fks_tpu.utils.segments import validate_seg_steps
 
 INF = jnp.iinfo(jnp.int32).max  # empty-slot sentinel
 
@@ -597,10 +598,7 @@ def make_segmented_population_run(workload: Workload, param_policy,
     segment dispatch — the flight recorder's segment counter
     (fks_tpu.obs); it runs between device calls, never inside them.
     """
-    if seg_steps <= 0:
-        raise ValueError(
-            f"seg_steps must be positive, got {seg_steps}; to disable "
-            "segmentation use make_population_run_fn")
+    seg_steps = validate_seg_steps(seg_steps, zero_disables=False)
     ktable, max_steps = loop_tables(workload, cfg)
 
     def step_one(prm, s):

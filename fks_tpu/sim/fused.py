@@ -106,6 +106,11 @@ def _build_plan(workload: Workload, cfg: SimConfig) -> _Plan:
         raise ValueError("decision trace is not supported in the fused "
                          "kernel; replay with engine='exact' or 'flat' "
                          "(fks_tpu.obs.tracing / cli trace-diff)")
+    if cfg.probe_score:
+        raise ValueError("budget probe rungs (SimConfig.probe_score, "
+                         "fks_tpu.funsearch.budget) are not supported in "
+                         "the fused kernel; run budget-pruned suite "
+                         "evaluation with engine='exact' or 'flat'")
     if workload.faults is not None:
         raise ValueError("fault-injected workloads (fks_tpu.scenarios "
                          "NODE_DOWN/NODE_UP events) are not supported in "

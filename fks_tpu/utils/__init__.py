@@ -8,9 +8,11 @@ from fks_tpu.utils.logging import MetricsWriter, get_logger, result_record
 from fks_tpu.utils.profiling import (
     ThroughputMeter, Timing, block_timed, device_trace, timed,
 )
+from fks_tpu.utils.segments import validate_seg_steps
 
 __all__ = [
     "MetricsWriter", "distributed_is_initialized", "get_logger",
     "result_record", "shard_map",
     "ThroughputMeter", "Timing", "block_timed", "device_trace", "timed",
+    "validate_seg_steps",
 ]

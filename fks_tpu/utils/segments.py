@@ -1,0 +1,39 @@
+"""Segment-length validation shared by every seg-steps surface.
+
+The segmented runner's length knob appears in two places with the same
+contract — ``FKS_VM_SEG_STEPS`` (environment, ``funsearch.backend``) and
+the ``seg_steps`` argument of ``sim.flat.make_segmented_population_run``
+— and historically each validated it with its own error text. One
+helper keeps the messages and the 0-disables rule identical: a segment
+length is a non-negative integer number of events, 0 means "do not
+segment" (the env var disables the segmented tier; the runner, which
+exists only to segment, points at ``make_population_run_fn`` instead).
+"""
+from __future__ import annotations
+
+from typing import Any
+
+
+def validate_seg_steps(value: Any, *, source: str = "seg_steps",
+                       zero_disables: bool = True) -> int:
+    """Validate a segment length and return it as an int.
+
+    ``source`` names the knob in error messages (e.g. the env var).
+    ``zero_disables=True`` accepts 0 as "segmentation off"; with False
+    (the segmented runner itself) 0 is rejected with a pointer to the
+    unsegmented entry point.
+    """
+    try:
+        steps = int(value)
+    except (TypeError, ValueError):
+        raise ValueError(
+            f"{source} must be an integer (segment length in events; "
+            f"0 disables segmentation), got {value!r}") from None
+    if steps < 0:
+        raise ValueError(
+            f"{source} must be >= 0 (0 disables segmentation), got {steps}")
+    if steps == 0 and not zero_disables:
+        raise ValueError(
+            f"{source} must be positive, got {steps}; to disable "
+            "segmentation use make_population_run_fn")
+    return steps

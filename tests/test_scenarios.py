@@ -293,6 +293,39 @@ def test_suite_eval_exact_vs_flat_parity(small_suite):
     assert small_suite.workloads[2].faults is not None
 
 
+@pytest.mark.parametrize("policy", ["first_fit", "best_fit"])
+def test_truncated_prefix_probe_parity(policy):
+    """Budget probe contract (fks_tpu.funsearch.budget): a run stopped at
+    ``probe_steps`` reports truncated=True and a fitness computed only
+    from the consumed event prefix — identical between the exact and flat
+    engines at 1e-5, nonzero (probe scoring lifts the zero-on-truncation
+    gate), and distinct from the full-run fitness."""
+    wl = synthetic_workload(4, 24, seed=3)
+    pol = zoo.ZOO[policy]()
+    probe_cfg = SimConfig(max_steps=16, probe_score=True)
+    scores = {}
+    for eng in ("exact", "flat"):
+        res = get_engine(eng).simulate(wl, pol, probe_cfg)
+        assert bool(res.truncated)
+        assert int(res.events_processed) <= 16
+        scores[eng] = float(res.policy_score)
+        assert scores[eng] > 0.0
+    assert abs(scores["exact"] - scores["flat"]) <= 1e-5
+    # same truncated run WITHOUT probe scoring: the finalize gate zeroes it
+    gated = get_engine("exact").simulate(wl, pol, SimConfig(max_steps=16))
+    assert bool(gated.truncated)
+    assert float(gated.policy_score) == 0.0
+    # the probe fitness is prefix-only, not the full-run fitness
+    full = get_engine("exact").simulate(wl, pol, SimConfig())
+    assert not bool(full.truncated)
+    assert abs(scores["exact"] - float(full.policy_score)) > 1e-6
+    # probe scoring changes NOTHING on a run that finishes: same config
+    # minus the step cap must reproduce the ungated full-run score
+    done = get_engine("exact").simulate(wl, pol, SimConfig(probe_score=True))
+    assert float(done.policy_score) == pytest.approx(
+        float(full.policy_score), abs=1e-9)
+
+
 def test_suite_population_eval_lane_isolation(small_suite):
     pop = parametric.init_population(jax.random.PRNGKey(0), 4, noise=0.3)
     per = np.asarray(

@@ -74,6 +74,11 @@ METRIC_KIND_REQUIRED: Dict[str, Tuple[str, ...]] = {
     # robust-fitness breakdown the evolution loop records
     "scenario_suite": ("suite", "version", "scenarios"),
     "robust_fitness": ("generation", "suite", "aggregation", "scores"),
+    # eval-budget allocation (fks_tpu.funsearch.budget): one record per
+    # rung per generation — who entered, who survived to the next rung,
+    # and what the rung cost in device wall seconds
+    "budget_rung": ("generation", "rung", "entered", "survived",
+                    "device_seconds"),
 }
 
 #: an OpenMetrics sample line: name, optional {labels}, value, optional ts
