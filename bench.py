@@ -56,14 +56,21 @@ from the jax.monitoring listener — separately from
    when >1 device is visible. Never fails the bench; falls back to the
    CURRENT round's session-recorded code measurement.
 
-Stages run as ``python bench.py --stage parity|throughput|codetput|budget``
-(argv, not env, so a leaked variable can't turn the top-level run into a
-bare stage). The ``budget`` stage is standalone (not part of the
-controller's headline pipeline): it measures the successive-halving
-eval-budget allocator (fks_tpu.funsearch.budget) — pruned-vs-full
-device seconds per generation at pop 64 x ``default8`` on the flat CPU
-engine — printing ``budget_speedup`` / ``budget_champion_match`` as its
-own JSON line, gateable with ``--gate``.
+Stages run as ``python bench.py --stage
+parity|throughput|codetput|budget|scale1k`` (argv, not env, so a leaked
+variable can't turn the top-level run into a bare stage). The ``budget``
+stage is standalone (not part of the controller's headline pipeline): it
+measures the successive-halving eval-budget allocator
+(fks_tpu.funsearch.budget) — pruned-vs-full device seconds per
+generation at pop 64 x ``default8`` on the flat CPU engine — printing
+``budget_speedup`` / ``budget_champion_match`` as its own JSON line,
+gateable with ``--gate``. The ``scale1k`` stage is likewise standalone:
+the large-cluster scale-tier headline (1k nodes x 100k synthetic pods
+run to completion on the flat CPU engine with
+``SimConfig.node_prefilter_k=64`` + ``state_pack`` and the
+double-buffered segmented runner), printing ``scale1k_events_per_sec``
+and a dense-vs-prefilter ``prefilter_speedup`` with a 1e-5
+fitness-parity gate built in.
 
 Fallback contract (round 6): when the device probe fails, the headline
 ``value``/``vs_baseline`` stay 0.0 (nothing was measured THIS run), and
@@ -411,6 +418,31 @@ def _cost_estimates(fn, *args) -> dict:
     return out
 
 
+def _memory_estimates(fn, *args) -> dict:
+    """Compiled-program memory footprint for the jitted ``fn`` at these
+    args: {"peak_live_bytes": ..., "temp_bytes": ...}. Peak live =
+    arguments + outputs + temporaries as reported by XLA's
+    ``memory_analysis()`` — the compile-time answer to "does this shape
+    fit", which CompileWatcher (a timing listener) cannot provide. Same
+    AOT / degrade-to-{} contract as ``_cost_estimates``."""
+    try:
+        mem = fn.lower(*args).compile().memory_analysis()
+    except Exception as e:  # noqa: BLE001 — estimates are best-effort
+        log(f"memory_analysis unavailable: {type(e).__name__}: {e}")
+        return {}
+    out = {}
+    try:
+        temp = int(getattr(mem, "temp_size_in_bytes"))
+        live = temp + int(getattr(mem, "argument_size_in_bytes")) \
+            + int(getattr(mem, "output_size_in_bytes"))
+    except (AttributeError, TypeError) as e:
+        log(f"memory_analysis fields unavailable: {e}")
+        return {}
+    out["peak_live_bytes"] = live
+    out["temp_bytes"] = temp
+    return out
+
+
 def stage_parity(engine: str) -> int:
     """CPU subprocess: exact-engine parity gate + flat-engine sanity."""
     import jax
@@ -537,6 +569,10 @@ def stage_throughput(pop: int, chunk: int, reps: int, engine: str) -> int:
         "backend_compiles": watcher.backend_compile_count,
         "first_call_seconds": round(t_compile, 3),
         "steady_state_seconds": round(best, 3),
+        # scale-tier knobs ride in every stage payload so rounds with
+        # different SimConfig defaults stay comparable
+        "node_prefilter_k": cfg.node_prefilter_k,
+        "state_pack": cfg.state_pack,
         # static per-chunk XLA cost (flops / bytes) for the compiled eval
         **_cost_estimates(ev, batches[0]),
     }))
@@ -626,6 +662,8 @@ def stage_codetput() -> int:
         "backend_compiles": watcher.backend_compile_count,
         "first_call_seconds": round(first_call, 3),
         "steady_state_seconds": round(best, 3),
+        "node_prefilter_k": cfg.node_prefilter_k,
+        "state_pack": cfg.state_pack,
         **cost,
     }))
     return 0
@@ -731,10 +769,202 @@ def stage_budget(gate: str = "") -> int:
         "steady_state_recompiles": recompiles,
         "backend_compiles": watcher.backend_compile_count,
         "compile_seconds": round(watcher.backend_compile_seconds, 3),
+        "node_prefilter_k": cfg.node_prefilter_k,
+        "state_pack": cfg.state_pack,
         **budget.describe(),
     }
     _record("metric", "bench_stage", payload, stage="budget",
             platform="cpu")
+    rc = 0
+    if gate:
+        rc = _gate(gate, payload)
+    _record("finish", "ok")
+    _record("close")
+    print(json.dumps(payload))
+    return rc
+
+
+def stage_scale1k(gate: str = "") -> int:
+    """CPU subprocess: large-cluster scale-tier headline — a 1k-node x
+    100k-pod synthetic workload (data.synthetic, OpenB-shaped) run to
+    completion through the flat engine's double-buffered segmented
+    runner with top-k node prefiltering and packed state dtypes on
+    (``SimConfig.node_prefilter_k`` / ``SimConfig.state_pack``). Prints
+    one JSON line with ``scale1k_events_per_sec`` (events processed /
+    wall, backend-compile time excluded) plus two dense-vs-prefilter
+    ratio sub-benchmarks at smaller pod counts, each with a
+    fitness-drift parity gate at 1e-5 (both use a first_fit-anchored
+    candidate, whose lowest-index-feasible winner always survives the
+    prefilter — drift is exactly 0):
+
+    - ``prefilter_speedup``: the VM CODE-CANDIDATE tier, where the
+      per-event node sweep dominates the step (a vmapped register-VM op
+      executes EVERY opcode branch per node, so dense cost is ~capacity
+      x opcodes x N; measured ~300 ms/step dense vs ~20 ms/step at k=64
+      on CPU). This is the production FunSearch evaluation path and the
+      tier the >= 3x acceptance claim is made on.
+    - ``parametric_prefilter_speedup``: the parametric-weights tier,
+      where the policy costs ~4 us/step dense at N=1000 and the step is
+      queue-dominated — prefiltering cannot pay on CPU (< 1x, the
+      documented negative result; see PROFILE.md round 11).
+
+    Also attaches the compiled hot-segment program's static XLA
+    cost/memory analysis.
+
+    Env knobs: FKS_BENCH_SCALE_NODES (1000), FKS_BENCH_SCALE_PODS
+    (100000), FKS_BENCH_SCALE_POP (4), FKS_BENCH_SCALE_PREFILTER_K (64),
+    FKS_BENCH_SCALE_RATIO_PODS (4096, parametric ratio pair),
+    FKS_BENCH_SCALE_VM_PODS (96 — the VM dense leg costs ~0.3 s/event on
+    CPU, so the pod count stays small), FKS_BENCH_SCALE_SEG_STEPS
+    (16384)."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+
+    from fks_tpu.data.synthetic import synthetic_workload
+    from fks_tpu.models import parametric
+    from fks_tpu.obs import CompileWatcher
+    from fks_tpu.sim import flat
+    from fks_tpu.sim.engine import SimConfig
+
+    global _RECORDER
+    _RECORDER = _controller_recorder()
+    watcher = CompileWatcher().install()
+    nodes = int(os.environ.get("FKS_BENCH_SCALE_NODES", "1000"))
+    pods = int(os.environ.get("FKS_BENCH_SCALE_PODS", "100000"))
+    pop = int(os.environ.get("FKS_BENCH_SCALE_POP", "4"))
+    k = int(os.environ.get("FKS_BENCH_SCALE_PREFILTER_K", "64"))
+    ratio_pods = int(os.environ.get("FKS_BENCH_SCALE_RATIO_PODS", "4096"))
+    vm_pods = int(os.environ.get("FKS_BENCH_SCALE_VM_PODS", "96"))
+    seg_steps = int(os.environ.get("FKS_BENCH_SCALE_SEG_STEPS", "16384"))
+    log(f"scale1k: {nodes} nodes x {pods} pods, pop={pop}, "
+        f"prefilter_k={k}, seg_steps={seg_steps}")
+
+    # first_fit-anchored parametric lanes: bias-only weights score every
+    # feasible node a constant, so argmax picks the lowest feasible index
+    # — the case where prefilter parity is EXACT, making the ratios below
+    # same-fitness comparisons, not approximate
+    params = jnp.tile(
+        jnp.asarray(parametric.seed_weights("first_fit"))[None], (pop, 1))
+
+    def timed_run(wl, cfg, policy=parametric.score, prms=None):
+        prms = params if prms is None else prms
+        run = flat.make_segmented_population_run(
+            wl, policy, cfg, seg_steps=seg_steps)
+        state0 = flat.initial_state(wl, cfg)
+        c0 = watcher.backend_compile_seconds
+        t0 = time.perf_counter()
+        res = run(prms, state0)
+        jax.block_until_ready(res.policy_score)
+        wall = time.perf_counter() - t0
+        compile_s = watcher.backend_compile_seconds - c0
+        events = int(np.asarray(res.events_processed).sum())
+        # single-pass protocol (a second 100k-pod pass would double the
+        # stage's wall time for no information): events/sec excludes the
+        # measured backend-compile seconds but still carries the host
+        # trace/lower overhead, so it reads slightly conservative
+        eps = events / max(1e-9, wall - compile_s)
+        return res, run, state0, eps, wall, compile_s
+
+    def ratio_pair(wl, max_steps, policy, prms, tier):
+        out = {}
+        for label, cfg_r in (
+                ("dense", SimConfig(max_steps=max_steps,
+                                    track_ctime=False)),
+                ("prefilter", SimConfig(max_steps=max_steps,
+                                        track_ctime=False,
+                                        node_prefilter_k=k,
+                                        state_pack=True))):
+            res_r, _, _, eps_r, wall_r, comp_r = timed_run(
+                wl, cfg_r, policy, prms)
+            out[label] = (eps_r, np.asarray(res_r.policy_score))
+            log(f"{tier}[{label}]: {eps_r:.0f} events/s "
+                f"(wall {wall_r:.2f}s, compile {comp_r:.2f}s)")
+        speedup = out["prefilter"][0] / out["dense"][0]
+        drift = float(np.max(np.abs(out["prefilter"][1]
+                                    - out["dense"][1])))
+        log(f"{tier} prefilter speedup: {speedup:.2f}x, "
+            f"fitness drift {drift:.2e}")
+        return out, speedup, drift
+
+    # -- VM code-candidate ratio: the tier where the node sweep dominates
+    # (and the >= 3x claim lives). The candidate is the template with
+    # first_fit logic (score = 1.0): constant on feasible nodes, so the
+    # argmax winner is the lowest feasible index — prefilter-exact — and
+    # the full template feasibility prologue still pays the real VM cost.
+    from fks_tpu.funsearch import template, vm
+    wl_v = synthetic_workload(nodes, vm_pods, seed=1)
+    code = template.TEMPLATE.replace(template.LOGIC_PLACEHOLDER,
+                                     "score = 1.0")
+    prog = vm.compile_policy(code, wl_v.cluster.n_padded,
+                             wl_v.cluster.g_padded, capacity=256)
+    stacked = vm.stack_programs([prog] * pop, capacity=256)
+    _, vm_speedup, vm_drift = ratio_pair(
+        wl_v, 4 * vm_pods, vm.score_static, stacked, "vm_ratio")
+
+    # -- parametric ratio: the cheap-policy tier, reported as the honest
+    # negative control (queue-dominated step; prefilter cannot pay here
+    # on CPU)
+    wl_r = synthetic_workload(nodes, ratio_pods, seed=1)
+    ratio, par_speedup, par_drift = ratio_pair(
+        wl_r, 4 * ratio_pods, parametric.score, params, "parametric_ratio")
+    drift = max(vm_drift, par_drift)
+    if drift > 1e-5:
+        log(f"SCALE PARITY FAIL: prefilter fitness drift {drift:.2e} > 1e-5")
+        return 1
+    speedup = vm_speedup
+
+    # -- headline: full-size completion run, prefilter + packed dtypes on
+    wl = synthetic_workload(nodes, pods, seed=1)
+    cfg = SimConfig(max_steps=4 * pods, track_ctime=False,
+                    node_prefilter_k=k, state_pack=True)
+    res, run, state0, eps, wall, compile_s = timed_run(wl, cfg)
+    if bool(np.asarray(res.truncated).any()):
+        log("SCALE FAIL: a lane hit max_steps before draining")
+        return 1
+    scheduled = int(np.asarray(res.scheduled_pods)[0])
+    events = int(np.asarray(res.events_processed).sum())
+    log(f"headline: {eps:.0f} events/s ({events} events, wall {wall:.2f}s, "
+        f"compile {compile_s:.2f}s); {scheduled}/{pods} pods scheduled")
+
+    # static analysis of the hot segment program (AOT — reuses shapes the
+    # jit already compiled; best-effort either way)
+    bstate0 = jax.tree_util.tree_map(
+        lambda leaf: jnp.broadcast_to(leaf, (pop,) + leaf.shape), state0)
+    analysis = {**_cost_estimates(run.advance, params, bstate0),
+                **_memory_estimates(run.advance, params, bstate0)}
+
+    payload = {
+        "scale1k_events_per_sec": round(eps, 1),
+        "scale1k_wall_seconds": round(wall, 3),
+        "compile_seconds": round(compile_s, 3),
+        "backend_compiles": watcher.backend_compile_count,
+        "events_processed": events,
+        "scheduled_pods": scheduled,
+        "nodes": nodes, "pods": pods, "population": pop,
+        "seg_steps": seg_steps,
+        "node_prefilter_k": k, "state_pack": True,
+        # VM code-candidate tier: the headline dense-vs-k ratio
+        "prefilter_speedup": round(speedup, 3),
+        "vm_ratio_pods": vm_pods,
+        # parametric tier: the negative control (queue-dominated step)
+        "parametric_prefilter_speedup": round(par_speedup, 3),
+        "dense_events_per_sec": round(ratio["dense"][0], 1),
+        "prefilter_events_per_sec": round(ratio["prefilter"][0], 1),
+        "ratio_pods": ratio_pods,
+        "fitness_drift": drift,
+        **analysis,
+    }
+    _record("metric", "bench_stage", payload, stage="scale1k",
+            platform="cpu")
+    # the schema-checked scale_tier record (tools/check_jsonl_schema.py):
+    # shape + knobs + throughput, the cross-round comparable core
+    _record("metric", "scale_tier", {
+        "nodes": nodes, "pods": pods,
+        "events_per_sec": round(eps, 1),
+        "node_prefilter_k": k, "state_pack": True,
+    }, platform="cpu")
     rc = 0
     if gate:
         rc = _gate(gate, payload)
@@ -834,6 +1064,10 @@ def main():
         # --gate itself (it prints its own JSON line, not the
         # controller's)
         return stage_budget(gate)
+    if stage == "scale1k":
+        # standalone large-cluster scale-tier headline (1k nodes x 100k
+        # pods, flat CPU); same self-contained --gate contract as budget
+        return stage_scale1k(gate)
 
     # controller (hard deadline so the driver always gets the JSON line;
     # every stage/probe timeout below is clamped to the remaining budget)

@@ -420,10 +420,18 @@ def cmd_scale(args):
     with _flight_recorder(args, "scale") as rec, \
             obs.watch_compiles(rec), \
             _metrics_writer(args) as metrics:  # up front: bad paths fail fast
+        node_park = None
+        if getattr(args, "openb_nodes", False):
+            from fks_tpu.data.traces import parse_node_yaml
+            # repo-root-relative resolution (default_traces_dir), so the
+            # vendored list loads from any cwd
+            node_park = parse_node_yaml()
         wl = synthetic_workload(args.nodes_count, args.pods_count,
-                                seed=args.seed)
+                                seed=args.seed, nodes=node_park)
         print(f"synthetic workload: {wl.num_nodes} nodes x {wl.num_pods} "
-              f"pods, population {args.pop}", file=sys.stderr)
+              f"pods, population {args.pop}"
+              + (" (OpenB node park)" if node_park else ""),
+              file=sys.stderr)
         if rec.enabled:
             rec.annotate_meta(engine=args.engine,
                               workload={"nodes": wl.num_nodes,
@@ -432,7 +440,8 @@ def cmd_scale(args):
             obs.record_devices(rec)
         pop = parametric.init_population(
             jax.random.PRNGKey(args.seed), args.pop, noise=0.1)
-        cfg = SimConfig()
+        cfg = SimConfig(node_prefilter_k=getattr(args, "prefilter_k", 0),
+                        state_pack=getattr(args, "state_pack", False))
         devices = jax.devices()
         try:
             if len(devices) > 1:
@@ -452,8 +461,11 @@ def cmd_scale(args):
                 scores = res.policy_score
                 mode = "vmap on 1 device"
         except ValueError as e:
-            if args.engine != "fused" or "VMEM" not in str(e):
-                raise  # only the fused kernel's VMEM guard gets guidance
+            if args.engine != "fused" or (
+                    "VMEM" not in str(e)
+                    and "node_prefilter_k" not in str(e)
+                    and "state_pack" not in str(e)):
+                raise  # only the fused kernel's guards get guidance
             print(f"error: {e}\n(try smaller --nodes-count/--pods-count, "
                   f"or --engine flat)", file=sys.stderr)
             return 2
@@ -466,6 +478,9 @@ def cmd_scale(args):
             "evals_per_sec": round(meter.rate, 3),
             "score_min": round(float(scores.min()), 4),
             "score_max": round(float(scores.max()), 4),
+            "node_prefilter_k": cfg.node_prefilter_k,
+            "state_pack": cfg.state_pack,
+            "openb_nodes": node_park is not None,
         }
         if getattr(args, "code_pop", 0) > 0:
             from fks_tpu.funsearch import vm
@@ -819,10 +834,26 @@ def main(argv=None) -> int:
 
     sc = sub.add_parser("scale", help="synthetic scale run + throughput",
                         parents=[common])
-    sc.add_argument("--nodes-count", type=int, default=1000)
-    sc.add_argument("--pods-count", type=int, default=100000)
+    sc.add_argument("--nodes-count", "--nodes", dest="nodes_count",
+                    type=int, default=1000)
+    sc.add_argument("--pods-count", "--pods", dest="pods_count",
+                    type=int, default=100000)
     sc.add_argument("--pop", type=int, default=8)
     sc.add_argument("--seed", type=int, default=0)
+    sc.add_argument("--prefilter-k", type=int, default=0,
+                    help="SimConfig.node_prefilter_k: score only the "
+                         "top-k statically-feasible nodes per event "
+                         "(0 = dense scan, bit-identical to the default "
+                         "program; flat engine only)")
+    sc.add_argument("--state-pack", action="store_true",
+                    help="SimConfig.state_pack: narrow flat-engine carry "
+                         "columns to 16-bit where the value range "
+                         "provably fits (exact integer packing)")
+    sc.add_argument("--openb-nodes", action="store_true",
+                    help="draw the node park from the vendored OpenB "
+                         "node list (benchmarks/traces/node_yaml/, 1213 "
+                         "nodes; --nodes-count selects a prefix) instead "
+                         "of the synthetic archetype sampler")
     sc.add_argument("--code-pop", type=int, default=0,
                     help="also measure the VM code-candidate tier with N "
                          "FakeLLM-lowered register programs (0 = off); "

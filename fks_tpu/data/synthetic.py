@@ -44,7 +44,8 @@ def synthetic_workload(num_nodes: int, num_pods: int, seed: int = 0,
                        horizon: int = 12_900_000,
                        gpu_pod_frac: float = 0.8665,
                        load: float | None = 0.45,
-                       pad_to: Tuple[int, int, int] | None = None) -> Workload:
+                       pad_to: Tuple[int, int, int] | None = None,
+                       nodes: Sequence[dict] | None = None) -> Workload:
     """Generate a cluster + pod stream of the requested size.
 
     ``horizon`` is the creation-time span (default: the default trace's
@@ -55,19 +56,34 @@ def synthetic_workload(num_nodes: int, num_pods: int, seed: int = 0,
     schedules; pass None to skip calibration and allow oversubscription,
     which exercises the retry/drop paths instead). ``pad_to`` optionally
     forces (N, G, P) padded shapes (used by bucketing).
+
+    ``nodes`` injects an externally-loaded node park (make_cluster-schema
+    dicts, e.g. ``fks_tpu.data.traces.parse_node_yaml()`` — the full
+    OpenB node list) in place of the archetype sampler; ``num_nodes``
+    then selects a prefix of the list (the synthetic pod stream and the
+    load calibration run against the injected park unchanged).
     """
     rng = np.random.default_rng(seed)
 
-    weights = np.array([t[0] for t in _NODE_TYPES])
-    kinds = rng.choice(len(_NODE_TYPES), size=num_nodes, p=weights / weights.sum())
-    nodes = []
-    for i, k in enumerate(kinds):
-        _, cpu, mem, ng = _NODE_TYPES[k]
-        nodes.append({
-            "node_id": f"snode-{i:05d}", "cpu_milli": int(cpu),
-            "memory_mib": int(mem), "gpus": [1000] * ng,
-            "gpu_memory_mib": 16384,
-        })
+    if nodes is not None:
+        nodes = list(nodes)
+        if num_nodes > len(nodes):
+            raise ValueError(
+                f"num_nodes {num_nodes} exceeds the injected node list "
+                f"({len(nodes)} nodes)")
+        nodes = nodes[:num_nodes]
+    else:
+        weights = np.array([t[0] for t in _NODE_TYPES])
+        kinds = rng.choice(len(_NODE_TYPES), size=num_nodes,
+                           p=weights / weights.sum())
+        nodes = []
+        for i, k in enumerate(kinds):
+            _, cpu, mem, ng = _NODE_TYPES[k]
+            nodes.append({
+                "node_id": f"snode-{i:05d}", "cpu_milli": int(cpu),
+                "memory_mib": int(mem), "gpus": [1000] * ng,
+                "gpu_memory_mib": 16384,
+            })
 
     is_gpu = rng.random(num_pods) < gpu_pod_frac
     counts = np.array([c for c, _ in _GPU_COUNTS])

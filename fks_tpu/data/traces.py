@@ -63,6 +63,97 @@ def _pad_to(n: int, multiple: int) -> int:
     return max(multiple, ((n + multiple - 1) // multiple) * multiple)
 
 
+def _parse_cpu_milli(v: str) -> int:
+    """k8s CPU quantity -> milli-cores: ``64000m`` or bare cores."""
+    v = v.strip().strip("'\"")
+    if v.endswith("m"):
+        return int(v[:-1])
+    return int(float(v) * 1000)
+
+
+def _parse_memory_mib(v: str) -> int:
+    """k8s memory quantity -> MiB: ``262144Mi`` plus the Ki/Gi/Ti scales."""
+    v = v.strip().strip("'\"")
+    for suffix, scale in (("Mi", 1.0), ("Gi", 1024.0), ("Ti", 1024.0 * 1024),
+                          ("Ki", 1.0 / 1024)):
+        if v.endswith(suffix):
+            return int(float(v[: -len(suffix)]) * scale)
+    return int(v)
+
+
+#: node-YAML keys we lift (allocatable block first -> first-seen wins)
+_NODE_YAML_KEYS = {
+    "alibabacloud.com/gpu-card-model": "model",
+    "kubernetes.io/hostname": "hostname",
+    "alibabacloud.com/gpu-count": "gpu_count",
+    "alibabacloud.com/gpu-milli": "gpu_milli",
+    "cpu": "cpu",
+    "memory": "memory",
+}
+
+
+def parse_node_yaml(path: str | Path | None = None,
+                    traces_dir: str | Path | None = None) -> List[dict]:
+    """The FULL OpenB node park (1,213 nodes) from the vendored k8s node
+    manifests at ``benchmarks/traces/node_yaml/`` — the large-cluster
+    scale tier's real node list (``cli scale --openb-nodes``,
+    ``data.synthetic.synthetic_workload(nodes=...)``).
+
+    Returns node dicts in ``fks_tpu.data.build.make_cluster`` schema
+    (``node_id``/``cpu_milli``/``memory_mib``/``gpus``/``gpu_memory_mib``)
+    in manifest order, which becomes the node index axis like CSV row
+    order does for the csv traces. Per-GPU milli capacity is
+    ``gpu-milli / gpu-count`` (1000 for every OpenB node); GPU memory
+    comes from the same ``gpu_mem_mapping.json`` the CSV parser uses,
+    keyed by the ``gpu-card-model`` label (0 for unmapped models,
+    matching ``parse_cluster``'s treatment).
+
+    The manifests are flat two-level YAML, parsed with line scanning so
+    the loader needs no yaml dependency; files may be gzipped like the
+    CSVs. Paths resolve against ``default_traces_dir()`` — repo-root-
+    relative, NOT cwd-relative — so ``cli scale`` works from any cwd
+    (the dataset lives at ``benchmarks/traces/node_yaml/``)."""
+    base = Path(traces_dir) if traces_dir is not None else default_traces_dir()
+    if path is None:
+        path = base / "node_yaml" / "openb_node_list_gpu_node.yaml"
+    with open(base / "gpu_mem_mapping.json") as f:
+        gpu_mem = json.load(f)
+
+    nodes: List[dict] = []
+
+    def flush(rec: Dict[str, str]) -> None:
+        if "cpu" not in rec:  # blank separator docs
+            return
+        count = int(rec.get("gpu_count", "0").strip("'\""))
+        milli = int(rec.get("gpu_milli", "0").strip("'\""))
+        per_gpu = milli // count if count else 0
+        nodes.append({
+            "node_id": rec.get("hostname", f"openb-node-{len(nodes):04d}"),
+            "cpu_milli": _parse_cpu_milli(rec["cpu"]),
+            "memory_mib": _parse_memory_mib(rec["memory"]),
+            "gpus": [per_gpu] * count,
+            "gpu_memory_mib": int(gpu_mem.get(rec.get("model", ""), 0)),
+        })
+
+    rec: Dict[str, str] = {}
+    with _open_text(Path(path)) as f:
+        for line in f:
+            stripped = line.strip()
+            if stripped.startswith("---"):
+                flush(rec)
+                rec = {}
+                continue
+            key, sep, value = stripped.partition(":")
+            if not sep:
+                continue
+            name = _NODE_YAML_KEYS.get(key.strip())
+            # first-seen wins: the allocatable block precedes capacity
+            if name is not None and value.strip() and name not in rec:
+                rec[name] = value.strip()
+    flush(rec)
+    return nodes
+
+
 class TraceParser:
     """Parse OpenB dataset traces into array-based simulation inputs.
 

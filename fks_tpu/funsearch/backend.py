@@ -180,6 +180,13 @@ class CodeEvaluator:
         else:
             self.vm_seg_steps = (
                 4096 if jax.default_backend() == "tpu" else 0)
+        # double-buffered segment handoff (flat.make_segmented_population
+        # _run): dispatch segment i+1 before syncing segment i's all-done
+        # flag, so the device never stalls on the host round-trip. On by
+        # default (results are pinned identical); FKS_VM_DOUBLE_BUFFER=0
+        # restores the classic sync-per-segment loop for debugging.
+        self.vm_double_buffer = (
+            os.environ.get("FKS_VM_DOUBLE_BUFFER", "1") not in ("0", ""))
 
     # ----- VM tier: one engine program, candidates as data
 
@@ -244,7 +251,8 @@ class CodeEvaluator:
                 self._vm_pop_run = self._mod.make_segmented_population_run(
                     self.workload, vm.score_static, self.cfg,
                     seg_steps=self.vm_seg_steps,
-                    on_segment=self._count_segment)
+                    on_segment=self._count_segment,
+                    double_buffer=self.vm_double_buffer)
             else:
                 self._vm_pop_run = jax.jit(
                     self._mod.make_population_run_fn(

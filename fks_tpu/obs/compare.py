@@ -57,6 +57,9 @@ DEFAULT_THRESHOLDS: Dict[str, Threshold] = {
     # pruned run's champion must keep matching the full run's (0/1 flag)
     "budget_speedup": Threshold(higher_is_better=True, rel=0.10),
     "budget_champion_match": Threshold(higher_is_better=True, abs_tol=0.0),
+    # large-cluster scale tier (bench stage_scale1k): 1k-node x 100k-pod
+    # completion throughput on the flat engine must not drop >10%
+    "scale1k_events_per_sec": Threshold(higher_is_better=True, rel=0.10),
 }
 
 
@@ -89,7 +92,8 @@ def _from_run_dir(run_dir: str) -> Dict[str, float]:
         if m.get("kind") != "bench_stage":
             continue
         for key in ("evals_per_sec", "code_evals_per_sec",
-                    "budget_speedup", "budget_champion_match"):
+                    "budget_speedup", "budget_champion_match",
+                    "scale1k_events_per_sec"):
             v = _num(m.get(key))
             if v is not None:
                 out[key] = max(out.get(key, 0.0), v)
@@ -123,7 +127,7 @@ def _from_jsonl(path: str) -> Dict[str, float]:
         for key in ("evals_per_sec", "code_evals_per_sec",
                     "compile_seconds", "best_score", "median_score",
                     "parity_max_drift", "budget_speedup",
-                    "budget_champion_match"):
+                    "budget_champion_match", "scale1k_events_per_sec"):
             v = _num(rec.get(key))
             if v is None:
                 continue

@@ -41,6 +41,7 @@ from fks_tpu.models import parametric
 from fks_tpu.parallel.population import ParamPolicyFn
 from fks_tpu.sim.engine import SimConfig, initial_state, make_population_run_fn
 from fks_tpu.utils.compat import shard_map
+from fks_tpu.utils.segments import segment_budget
 
 POP_AXIS = "pop"
 DCN_AXIS = "dcn"
@@ -417,8 +418,13 @@ def _make_segmented_code_eval(workload: Workload, mesh: Mesh, cfg: SimConfig,
     mirrored one level up, at the mesh. Per segment every shard advances
     its lanes ~``seg_steps`` events inside a bounded while_loop; one
     psum'd any-lane-active flag returns to the host, which re-dispatches
-    until every lane on every shard drains (same carry, same segment
-    budget, same divergence guard as the single-device runner)."""
+    until every lane on every shard drains (same carry, same divergence
+    guard as the single-device runner). The handoff is double-buffered
+    like ``flat.make_segmented_population_run``'s: segment i+1 is
+    dispatched before segment i's psum'd flag is read, so no shard ever
+    stalls on the host's flag sync; the flag lags one segment, the one
+    overrun segment self-masks to a no-op on every shard, and the budget
+    carries the matching extra observation slot (slack 2)."""
     from fks_tpu.funsearch import vm
 
     axes = _pop_axes(mesh)
@@ -478,12 +484,17 @@ def _make_segmented_code_eval(workload: Workload, mesh: Mesh, cfg: SimConfig,
         bstate = jax.device_put(mod.broadcast_state(state0, pop),
                                 NamedSharding(mesh, P(_pop_axes(mesh))))
         active = True
-        for _ in range(-(-max_steps // seg_steps) + 1):
+        prev = None
+        for _ in range(segment_budget(max_steps, seg_steps, slack=2)):
             bstate, active = advance(stacked, bstate)
             if on_segment is not None:
                 on_segment()
-            if not bool(active):  # the only per-segment host sync
+            # double-buffered handoff: sync on the PREVIOUS segment's
+            # psum'd flag only after this segment is already in flight
+            if prev is not None and not bool(prev):
+                active = prev
                 break
+            prev = active
         if bool(active):
             raise RuntimeError(
                 "sharded segmented runner exhausted its segment budget "

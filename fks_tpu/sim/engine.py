@@ -43,7 +43,7 @@ from fks_tpu.ops.heap import (
     first_deletion_in_array_order, heap_from_events, heap_pop, heap_push,
 )
 from fks_tpu.sim.evaluator import max_snapshot_count, snapshot_trigger_table
-from fks_tpu.sim.guards import fitness_flags, sanitize_scores, score_flags
+from fks_tpu.sim.guards import fitness_flags, guard_scores
 from fks_tpu.sim.types import (
     TRACE_CREATE, TRACE_DELETE, TRACE_NODE_DOWN, TRACE_NODE_UP, TRACE_RETRY,
     NodeView, PodView, PolicyFn, SimResult, SimState, TraceBuffer, empty_trace,
@@ -101,6 +101,46 @@ class SimConfig:
     # default-off path selects the same jnp.where gate expression as
     # before, compiling the identical program.
     probe_score: bool = False
+    # large-cluster scale tier (README "Large-cluster scale tier"): top-k
+    # candidate-node prefiltering. 0 (the default) sweeps every node per
+    # event exactly as before — Python-static like ``watchdog``, so the
+    # disabled path compiles the bit-identical program. k > 0 ranks nodes
+    # by a cheap static feasibility score (free CPU/mem/GPU fit under the
+    # cordon mask, ties to the LOWEST node index — dense argmax's tie
+    # rule), gathers the top k into a [k, ...] NodeView, runs the policy
+    # on that view only, and maps the winner back to the global node
+    # index. Exact (placement-sequence-preserving) for policies that
+    # refuse infeasible nodes and prefer lower indices among equal scores
+    # (first_fit and every zoo/parametric feasibility-gated policy on its
+    # preferred node); for other policies the winner is the argmax over
+    # the candidate set, so fitness parity vs the dense sweep must be
+    # validated per policy (tests/test_scale_tier.py). k >= n_padded
+    # falls back to the dense sweep (a full gather is strictly slower).
+    node_prefilter_k: int = 0
+    # packed state dtypes (flat engine only; the exact engine ignores the
+    # flag). True narrows FlatState columns whose full value range is
+    # exactly representable at this workload's shape — gpu_milli_left /
+    # gpu_left / wait_hist / aux to int16, aux_gpus to uint16 — halving
+    # the while_loop carry bandwidth for those columns with ZERO fitness
+    # drift (integer packing is exact; columns whose range cannot be
+    # proven at this shape stay int32, so the knob degrades to a no-op
+    # rather than wrapping). bfloat16 accumulators were REJECTED by the
+    # parity sweep (PROFILE.md round 11: ~1e-3 fitness drift vs the 1e-5
+    # bar), so snap_sums/frag_sum stay at ``score_dtype``. Python-static:
+    # the default-off path compiles the bit-identical program.
+    state_pack: bool = False
+
+    def resolve_prefilter_k(self, n_padded: int) -> int:
+        """Static candidate count for top-k node prefiltering: 0 means
+        dense sweep. Values >= n_padded fall back to 0 (gathering every
+        node in rank order is strictly slower than the dense sweep and
+        would perturb argmax tie-breaks for nothing)."""
+        k = self.node_prefilter_k
+        if k < 0:
+            raise ValueError(
+                f"node_prefilter_k must be >= 0 (0 disables prefiltering), "
+                f"got {k}")
+        return k if 0 < k < n_padded else 0
 
     def resolve_max_steps(self, num_pods: int) -> int:
         if self.max_steps is not None:
@@ -243,6 +283,55 @@ def _node_view(c: ClusterArrays, cpu_left, mem_left, gpu_left, gpu_milli_left):
     )
 
 
+def _prefilter_candidates(pod: PodView, nodes: NodeView, place_mask, k: int):
+    """Top-k candidate nodes for one creation event (SimConfig
+    ``node_prefilter_k``): rank every node by a cheap static feasibility
+    test — the same free CPU/mem/GPU-count/GPU-milli fit the zoo policies
+    gate on (fks_tpu.models.zoo.feasible_mask), under ``place_mask`` so a
+    cordoned or padding node can NEVER enter a candidate slot — and keep
+    the k best, i.e. the first k FEASIBLE nodes in ascending global
+    index: argmax over the gathered view then preserves the dense sweep's
+    lowest-index tie rule exactly. Selection is a cumsum + one-hot argmax
+    (candidate slot j = first node whose running feasible-count is j),
+    NOT ``jax.lax.top_k``: the rank order is already "feasible by
+    ascending index", so a full selection sort buys nothing — and a
+    vmapped top_k(1000, 64) measures ~1.2 ms/call on CPU, 4x an entire
+    dense step — while the one-hot form is O(N*k) dense vectorized work
+    and stays scatter-free (the TPU design rule every state write in this
+    engine follows). When fewer than k nodes are feasible, the unmatched
+    tail repeats the FIRST candidate, so whenever any feasible node
+    exists every slot holds a feasible one (cordoned/padding nodes never
+    enter the list) and duplicates tie in the winner argmax at the same
+    global node. Only when NO node is feasible does the list degrade to
+    node 0 — callers re-mask through the gather (``place_mask[cand]``
+    with the ``> 0`` placement gate), so that event fails exactly like
+    the dense sweep. Returns i32[k] global node indices."""
+    eligible = jnp.sum(
+        (nodes.gpu_mask & (nodes.gpu_milli_left >= pod.gpu_milli)
+         ).astype(jnp.int32), axis=1)
+    gpu_ok = jnp.where(pod.num_gpu > 0, eligible >= pod.num_gpu, True)
+    feasible = (place_mask
+                & (pod.cpu_milli <= nodes.cpu_milli_left)
+                & (pod.memory_mib <= nodes.memory_mib_left)
+                & (pod.num_gpu <= nodes.gpu_left) & gpu_ok)
+    # slot of node i among feasibles = #feasible before it; infeasible
+    # nodes get an out-of-range slot so they match no candidate column
+    slot = jnp.where(feasible,
+                     jnp.cumsum(feasible.astype(jnp.int32)) - 1,
+                     jnp.int32(-1))
+    k_iota = jnp.arange(k, dtype=jnp.int32)
+    onehot = slot[:, None] == k_iota[None, :]
+    cand = jnp.argmax(onehot, axis=0).astype(jnp.int32)
+    return jnp.where(k_iota < jnp.sum(feasible.astype(jnp.int32)),
+                     cand, cand[0])
+
+
+def _gather_node_view(nodes: NodeView, cand) -> NodeView:
+    """The [k, ...] candidate view: every NodeView leaf gathered along the
+    node axis (leaves are [N] or [N, G]; a row gather covers both)."""
+    return NodeView(*(leaf[cand] for leaf in nodes))
+
+
 def lane_active(s: SimState, max_steps: int):
     """THE termination predicate: a lane keeps stepping while events remain,
     no GPU-allocation abort happened, and the runaway guard holds. Single
@@ -295,6 +384,8 @@ def build_step(workload: Workload, policy: PolicyFn, cfg: SimConfig,
     # Python-static fault gating (like watchdog/decision_trace): fault-free
     # workloads compile to the exact pre-scenario program.
     has_faults = workload.faults is not None
+    # large-cluster scale tier: 0 = dense sweep (bit-identical program)
+    prefilter_k = cfg.resolve_prefilter_k(n)
 
     def step(s: SimState) -> SimState:
         active = lane_active(s, max_steps)
@@ -342,6 +433,14 @@ def build_step(workload: Workload, policy: PolicyFn, cfg: SimConfig,
         # ---- CREATION: score every node, strict argmax (main.py:101-111)
         pod_view = PodView(pcpu, pmem, pngpu, pmilli, pod_ct, pdur)
         node_view = _node_view(c, cpu_left, mem_left, gpu_left, gpu_milli_left)
+        if prefilter_k:
+            # a cordoned (downed) node scores 0 until NODE_UP — under the
+            # prefilter it must also never outrank a feasible candidate,
+            # so the cordon mask feeds the ranking itself
+            place_mask = c.node_mask & node_avail if has_faults else c.node_mask
+            cand = _prefilter_candidates(
+                pod_view, node_view, place_mask, prefilter_k)
+            node_view = _gather_node_view(node_view, cand)
         if cfg.cond_policy:
             out = jax.eval_shape(policy, pod_view, node_view)
             raw_scores = jax.lax.cond(
@@ -349,15 +448,22 @@ def build_step(workload: Workload, policy: PolicyFn, cfg: SimConfig,
                 lambda: jnp.zeros(out.shape, out.dtype))
         else:
             raw_scores = policy(pod_view, node_view)
-        numeric_flags = s.numeric_flags
-        if cfg.watchdog:
-            numeric_flags = numeric_flags | score_flags(raw_scores, create)
-            raw_scores = sanitize_scores(raw_scores)
-        # a cordoned (downed) node scores 0 — "cannot/refuse" — until NODE_UP
-        place_mask = c.node_mask & node_avail if has_faults else c.node_mask
-        scores = jnp.where(place_mask, raw_scores, 0)
-        b = jnp.argmax(scores).astype(jnp.int32)
-        placed = create & (scores[b] > 0)
+        raw_scores, numeric_flags = guard_scores(
+            raw_scores, create, s.numeric_flags, enabled=cfg.watchdog)
+        if prefilter_k:
+            # re-mask through the gather: when fewer than k nodes are
+            # feasible the candidate tail is padding (cordoned nodes
+            # included) — zero those slots whatever the policy scored
+            scores = jnp.where(place_mask[cand], raw_scores, 0)
+        else:
+            # a cordoned (downed) node scores 0 — "cannot/refuse" — until NODE_UP
+            place_mask = c.node_mask & node_avail if has_faults else c.node_mask
+            scores = jnp.where(place_mask, raw_scores, 0)
+        # wk indexes the scored view ([k] candidates or [N] nodes);
+        # b is always the GLOBAL node index (gather-back through cand)
+        wk = jnp.argmax(scores).astype(jnp.int32)
+        b = cand[wk] if prefilter_k else wk
+        placed = create & (scores[wk] > 0)
 
         # GPU sub-allocation on the winner (main.py:125-145)
         sel, ok = alloc(gpu_milli_left[b], c.gpu_mask[b], pmilli, pngpu)
@@ -471,10 +577,12 @@ def build_step(workload: Workload, policy: PolicyFn, cfg: SimConfig,
                 tpod = jnp.where(is_fault, -1, tpod)
                 tnode = jnp.where(is_fault, pod, tnode)
                 fault_kw = dict(fault_down=fault_down, fault_up=fault_up)
+            # winner indexes the scored view (local top-k slot when
+            # prefiltered); tnode above already carries the GLOBAL index b
             trace = _trace_append(
                 trace, active=active, create=create, is_del=is_del,
                 was_waiting=was_waiting, pod=tpod, node=tnode,
-                scores=scores, winner=b, pending=heap3.size,
+                scores=scores, winner=wk, pending=heap3.size,
                 cpu_left=cpu_left, mem_left=mem_left, gpu_left=gpu_left,
                 gpu_milli_left=gpu_milli_left, **fault_kw)
 

@@ -78,12 +78,13 @@ from fks_tpu.data.entities import Workload
 from fks_tpu.ops.allocator import best_fit_gpus, first_fit_gpus
 from fks_tpu.ops.heap import KIND_NODE_UP
 from fks_tpu.sim.engine import (
-    SimConfig, _audit, _node_view, _trace_append, _widest_int,
-    finalize_fields, loop_tables, run_batched_lanes,
+    SimConfig, _audit, _gather_node_view, _node_view, _prefilter_candidates,
+    _trace_append, _widest_int, finalize_fields, loop_tables,
+    run_batched_lanes,
 )
-from fks_tpu.sim.guards import sanitize_scores, score_flags
+from fks_tpu.sim.guards import guard_scores
 from fks_tpu.sim.types import FlatState, PodView, PolicyFn, SimResult, empty_trace
-from fks_tpu.utils.segments import validate_seg_steps
+from fks_tpu.utils.segments import segment_budget, validate_seg_steps
 
 INF = jnp.iinfo(jnp.int32).max  # empty-slot sentinel
 
@@ -100,6 +101,42 @@ def _node_bits(n_padded: int) -> int:
 def _packable(n_padded: int, g_padded: int) -> bool:
     """Can (node, gpu_bits) share one non-negative int32?"""
     return _node_bits(n_padded) + g_padded <= 31
+
+
+def _pack_dtypes(cfg: SimConfig, c, p) -> dict:
+    """Per-column carry dtypes under ``SimConfig.state_pack`` (flat engine
+    only). Packing is strictly EXACT: a column narrows to 16 bits only
+    when its full value range provably fits at this workload's shape —
+    per-GPU milli capacity <= 32767 for ``gpu_milli_left``, declared GPU
+    count for ``gpu_left``, pod count for ``wait_hist`` (bucket counts
+    cannot exceed waiting pods), node/GPU encoding width for ``aux`` /
+    ``aux_gpus`` (the -1/-2 sentinels need the sign bit, so the packed
+    encoding must fit 14 value bits). Columns that cannot prove their
+    range stay int32 — the knob degrades shape-by-shape to a no-op, never
+    to wraparound. Step arithmetic still promotes to int32 (so policies
+    always see int32 views); only the while_loop CARRY narrows, halving
+    its bandwidth for these columns. With ``state_pack=False`` every
+    entry is the historical int32/uint32 and the compiled program is
+    bit-identical."""
+    i32, u32 = jnp.int32, jnp.uint32
+    if not cfg.state_pack:
+        return dict(aux=i32, aux_gpus=u32, wait_hist=i32,
+                    gpu_left=i32, gpu_milli_left=i32)
+    n, g = c.n_padded, c.g_padded
+    if _packable(n, g):
+        aux_fits = _node_bits(n) + g <= 14
+    else:
+        aux_fits = n <= 32767  # unpacked aux holds a bare node index
+    max_pg_milli = int(np.asarray(c.gpu_milli_total).max(initial=0))
+    max_gd = int(np.asarray(c.gpu_declared).max(initial=0))
+    num_real = int(np.asarray(p.pod_mask).sum())
+    return dict(
+        aux=jnp.int16 if aux_fits else i32,
+        aux_gpus=jnp.uint16 if g <= 16 else u32,
+        wait_hist=jnp.int16 if num_real <= 32767 else i32,
+        gpu_left=jnp.int16 if max_gd <= 32767 else i32,
+        gpu_milli_left=jnp.int16 if max_pg_milli <= 32767 else i32,
+    )
 
 
 def _rank_perm(pod_mask, tie_rank):
@@ -131,17 +168,18 @@ def initial_state(workload: Workload, cfg: SimConfig) -> FlatState:
             f"wait_hist_size {hist_size} <= trace max gpu_milli; "
             "fragmentation min_needed would be miscounted")
     f = cfg.score_dtype
+    dt = _pack_dtypes(cfg, c, p)
     return FlatState(
         ev_time=jnp.asarray(ev_time, jnp.int32),
-        aux=jnp.full(pp, AUX_FRESH, jnp.int32),
-        aux_gpus=None if packed else jnp.zeros(pp, jnp.uint32),
+        aux=jnp.full(pp, AUX_FRESH, dt["aux"]),
+        aux_gpus=None if packed else jnp.zeros(pp, dt["aux_gpus"]),
         pending=jnp.int32(int(pm.sum())),
         cpu_left=jnp.asarray(c.cpu_total, jnp.int32),
         mem_left=jnp.asarray(c.mem_total, jnp.int32),
-        gpu_left=jnp.asarray(c.gpu_declared, jnp.int32),
-        gpu_milli_left=jnp.asarray(c.gpu_milli_total, jnp.int32),
+        gpu_left=jnp.asarray(c.gpu_declared, dt["gpu_left"]),
+        gpu_milli_left=jnp.asarray(c.gpu_milli_total, dt["gpu_milli_left"]),
         pod_ctime=jnp.asarray(np.asarray(p.creation_time)[perm], jnp.int32),
-        wait_hist=jnp.zeros(hist_size, jnp.int32),
+        wait_hist=jnp.zeros(hist_size, dt["wait_hist"]),
         events_processed=jnp.int32(0),
         snap_idx=jnp.int32(0),
         snap_sums=jnp.zeros(4, f),
@@ -224,6 +262,8 @@ def build_step(workload: Workload, policy: PolicyFn, cfg: SimConfig,
     if has_faults:
         flt = jax.tree_util.tree_map(jnp.asarray, workload.faults)
         f_iota = jnp.arange(flt.time.shape[0], dtype=jnp.int32)
+    # large-cluster scale tier: 0 = dense sweep (bit-identical program)
+    prefilter_k = cfg.resolve_prefilter_k(n)
 
     def step(s: FlatState) -> FlatState:
         active = lane_active(s, max_steps)
@@ -289,6 +329,14 @@ def build_step(workload: Workload, policy: PolicyFn, cfg: SimConfig,
         # it always equals the event time).
         pod_view = PodView(pcpu, pmem, pngpu, pmilli, t, pdur)
         node_view = _node_view(c, cpu_left, mem_left, gpu_left, gpu_milli_left)
+        if prefilter_k:
+            # a cordoned (downed) node scores 0 until NODE_UP — under the
+            # prefilter it must also never outrank a feasible candidate,
+            # so the cordon mask feeds the ranking itself
+            place_mask = c.node_mask & node_avail if has_faults else c.node_mask
+            cand = _prefilter_candidates(
+                pod_view, node_view, place_mask, prefilter_k)
+            node_view = _gather_node_view(node_view, cand)
         if cfg.cond_policy:
             out = jax.eval_shape(policy, pod_view, node_view)
             raw_scores = jax.lax.cond(
@@ -296,15 +344,22 @@ def build_step(workload: Workload, policy: PolicyFn, cfg: SimConfig,
                 lambda: jnp.zeros(out.shape, out.dtype))
         else:
             raw_scores = policy(pod_view, node_view)
-        numeric_flags = s.numeric_flags
-        if cfg.watchdog:
-            numeric_flags = numeric_flags | score_flags(raw_scores, create)
-            raw_scores = sanitize_scores(raw_scores)
-        # a cordoned (downed) node scores 0 — "cannot/refuse" — until NODE_UP
-        place_mask = c.node_mask & node_avail if has_faults else c.node_mask
-        scores = jnp.where(place_mask, raw_scores, 0)
-        w = jnp.argmax(scores).astype(jnp.int32)
-        placed = create & (scores[w] > 0)
+        raw_scores, numeric_flags = guard_scores(
+            raw_scores, create, s.numeric_flags, enabled=cfg.watchdog)
+        if prefilter_k:
+            # re-mask through the gather: when fewer than k nodes are
+            # feasible the candidate tail is padding (cordoned nodes
+            # included) — zero those slots whatever the policy scored
+            scores = jnp.where(place_mask[cand], raw_scores, 0)
+        else:
+            # a cordoned (downed) node scores 0 — "cannot/refuse" — until NODE_UP
+            place_mask = c.node_mask & node_avail if has_faults else c.node_mask
+            scores = jnp.where(place_mask, raw_scores, 0)
+        # wk indexes the scored view ([k] candidates or [N] nodes);
+        # w is always the GLOBAL node index (gather-back through cand)
+        wk = jnp.argmax(scores).astype(jnp.int32)
+        w = cand[wk] if prefilter_k else wk
+        placed = create & (scores[wk] > 0)
 
         sel, ok = alloc(gpu_milli_left[w], c.gpu_mask[w], pmilli, pngpu)
         alloc_fail = placed & (pngpu > 0) & ~ok  # reference raises here
@@ -318,6 +373,14 @@ def build_step(workload: Workload, policy: PolicyFn, cfg: SimConfig,
             oh_w[:, None] * pmilli * sel.astype(jnp.int32)[None, :])
         new_bits = jnp.sum(jnp.where(sel, jnp.uint32(1) << g_iota,
                                      jnp.uint32(0)), dtype=jnp.uint32)
+        # packed-carry handoff (SimConfig.state_pack): the refund/placement
+        # arithmetic above promotes to int32 (policies always see int32
+        # views); narrow back to the carry dtype. The Python guards keep
+        # the unpacked path contributing zero jaxpr equations.
+        if gpu_left.dtype != s.gpu_left.dtype:
+            gpu_left = gpu_left.astype(s.gpu_left.dtype)
+        if gpu_milli_left.dtype != s.gpu_milli_left.dtype:
+            gpu_milli_left = gpu_milli_left.astype(s.gpu_milli_left.dtype)
 
         # ---- failed creation: waiting set + fragmentation + retry
         failp = create & ~placed
@@ -326,6 +389,8 @@ def build_step(workload: Workload, policy: PolicyFn, cfg: SimConfig,
                   - (pl & was_waiting & (pngpu > 0)).astype(jnp.int32))
         h_iota = jnp.arange(s.wait_hist.shape[0], dtype=jnp.int32)
         hist = s.wait_hist + (h_iota == bucket).astype(jnp.int32) * hdelta
+        if hist.dtype != s.wait_hist.dtype:  # state_pack carry handoff
+            hist = hist.astype(s.wait_hist.dtype)
 
         hvals = hist > 0
         has_gpu_waiting = jnp.any(hvals)
@@ -358,13 +423,17 @@ def build_step(workload: Workload, policy: PolicyFn, cfg: SimConfig,
         else:
             enc = w
         new_aux = jnp.where(pl, enc, jnp.where(failp, AUX_WAITING, aux_s))
+        if new_aux.dtype != s.aux.dtype:  # state_pack carry handoff
+            new_aux = new_aux.astype(s.aux.dtype)
         m = (q_iota == sidx) & pod_act
         ev_time = jnp.where(m, new_t, s.ev_time)
         aux = jnp.where(m, new_aux, s.aux)
         aux_gpus = s.aux_gpus
         if not packed:
-            aux_gpus = jnp.where(
-                m, jnp.where(pl, new_bits, held_bits), s.aux_gpus)
+            upd_bits = jnp.where(pl, new_bits, held_bits)
+            if upd_bits.dtype != s.aux_gpus.dtype:  # state_pack handoff
+                upd_bits = upd_bits.astype(s.aux_gpus.dtype)
+            aux_gpus = jnp.where(m, upd_bits, s.aux_gpus)
         pod_ctime = (jnp.where(m & retry, rt, s.pod_ctime)
                      if cfg.track_ctime else s.pod_ctime)
         pending = s.pending - (is_del | dropped).astype(jnp.int32)
@@ -451,7 +520,9 @@ def _decode_assignment(aux, aux_gpus, g: int, packed: bool):
     else:
         an = jnp.where(aux >= 0, aux, -1)
         ag = jnp.where(aux >= 0, aux_gpus, jnp.uint32(0))
-    return an, ag
+    # SimResult dtypes stay int32/uint32 whatever the carry dtypes were
+    # (state_pack): a no-op convert when the carry is already wide
+    return an.astype(jnp.int32), ag.astype(jnp.uint32)
 
 
 class _FinalView(NamedTuple):
@@ -486,8 +557,10 @@ def finalize(workload: Workload, cfg: SimConfig, s: FlatState) -> SimResult:
     view = _FinalView(
         assigned_node=an[inv], assigned_gpus=ag[inv],
         pod_ctime=s.pod_ctime[inv],
-        cpu_left=s.cpu_left, mem_left=s.mem_left, gpu_left=s.gpu_left,
-        gpu_milli_left=s.gpu_milli_left,
+        cpu_left=s.cpu_left, mem_left=s.mem_left,
+        # widen packed carries so SimResult dtypes are config-independent
+        gpu_left=s.gpu_left.astype(jnp.int32),
+        gpu_milli_left=s.gpu_milli_left.astype(jnp.int32),
         events_processed=s.events_processed, snap_idx=s.snap_idx,
         snap_sums=s.snap_sums, frag_sum=s.frag_sum, frag_count=s.frag_count,
         max_nodes=s.max_nodes, failed=s.failed, violations=s.violations,
@@ -574,7 +647,8 @@ def make_population_run_fn(workload: Workload, param_policy,
 def make_segmented_population_run(workload: Workload, param_policy,
                                   cfg: SimConfig = SimConfig(),
                                   seg_steps: int = 4096,
-                                  on_segment=None):
+                                  on_segment=None,
+                                  double_buffer: bool = True):
     """``make_population_run_fn`` with a bounded device-call length: the
     while_loop stops every ``seg_steps`` events and the carry returns to
     the host, which re-dispatches until every lane drains.
@@ -583,20 +657,30 @@ def make_segmented_population_run(workload: Workload, param_policy,
     TPU tunnel kills calls over ~60 s — bench.py protocol notes): a
     full-trace batched-VM launch or a 100k-pod scale run can exceed the
     window no matter the population size, since wall time scales with
-    steps, not lanes. Overhead per segment is one dispatch plus one
-    scalar device->host sync (the any-lane-active flag travels with the
-    carry, not as a second dispatch). Active lanes advance in lockstep
-    (the self-masking step freezes only finished lanes), so
-    ``steps - start`` is uniform across active lanes and the segment
-    bound is exact.
+    steps, not lanes. Active lanes advance in lockstep (the self-masking
+    step freezes only finished lanes), so ``steps - start`` is uniform
+    across active lanes and the segment bound is exact.
 
-    Results are identical to the unsegmented runner: the carry is the
-    same, only the while_loop is split (pinned by
-    tests/test_flat_engine.py::test_segmented_population_matches).
+    ``double_buffer`` (default on) pipelines the segment handoff: segment
+    i+1 is dispatched BEFORE segment i's any-lane-active flag is read, so
+    the device never waits for the host's flag sync — JAX's async
+    dispatch keeps the next segment's program (and its event-block carry)
+    enqueued while the current one runs. The flag therefore lags one
+    segment behind the dispatch front and the loop runs exactly one
+    overrun segment past the draining one; drained lanes stay drained
+    (``lane_active`` is monotonic), the overrun segment self-masks to a
+    no-op, and results stay identical to the unsegmented runner — pinned
+    by tests/test_flat_engine.py::test_segmented_population_matches.
+    ``double_buffer=False`` restores the classic sync-per-segment loop
+    (one scalar device->host sync per segment).
 
     ``on_segment`` (zero-arg callable) fires on the host after every
     segment dispatch — the flight recorder's segment counter
     (fks_tpu.obs); it runs between device calls, never inside them.
+
+    The returned ``run`` exposes ``run.advance`` (the jitted one-segment
+    program) and ``run.seg_steps`` so bench harnesses can AOT-lower the
+    hot program for cost/memory introspection without a second compile.
     """
     seg_steps = validate_seg_steps(seg_steps, zero_disables=False)
     ktable, max_steps = loop_tables(workload, cfg)
@@ -631,13 +715,25 @@ def make_segmented_population_run(workload: Workload, param_policy,
         # the compile is trivial — no loop in the program)
         bstate = _broadcast_jit(state0, pop)
         # segment count is bounded by the step budget, so a cond/step
-        # divergence cannot spin the host loop forever
+        # divergence cannot spin the host loop forever. The double-
+        # buffered loop reads a flag that lags one segment, so it needs
+        # one extra observation slot in the budget (slack 2 vs 1).
         active = True
-        for _ in range(-(-max_steps // seg_steps) + 1):
+        prev = None
+        for _ in range(segment_budget(max_steps, seg_steps,
+                                      slack=2 if double_buffer else 1)):
             bstate, active = advance(params, bstate)
             if on_segment is not None:
                 on_segment()
-            if not bool(active):  # the only per-segment host sync
+            if double_buffer:
+                # sync on the PREVIOUS segment's flag only after this
+                # segment is already in flight: the device pipeline never
+                # stalls on the host round-trip
+                if prev is not None and not bool(prev):
+                    active = prev
+                    break
+                prev = active
+            elif not bool(active):  # the only per-segment host sync
                 break
         if bool(active):
             # the budget above is exact for lockstep lanes; reaching it
@@ -649,4 +745,7 @@ def make_segmented_population_run(workload: Workload, param_policy,
                 "still active — cond/step divergence in the flat engine")
         return finalize_pop(bstate)
 
+    run.advance = advance
+    run.finalize_pop = finalize_pop
+    run.seg_steps = seg_steps
     return run

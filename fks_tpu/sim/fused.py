@@ -116,6 +116,16 @@ def _build_plan(workload: Workload, cfg: SimConfig) -> _Plan:
                          "NODE_DOWN/NODE_UP events) are not supported in "
                          "the fused kernel; evaluate scenario suites with "
                          "engine='exact' or 'flat'")
+    if cfg.node_prefilter_k:
+        raise ValueError("top-k node prefiltering (SimConfig."
+                         "node_prefilter_k) is not supported in the fused "
+                         "kernel — its fixed-function policy already "
+                         "sweeps nodes in one fused pass; use "
+                         "engine='flat' for the large-cluster scale tier")
+    if cfg.state_pack:
+        raise ValueError("packed state dtypes (SimConfig.state_pack) are "
+                         "not supported in the fused kernel; use "
+                         "engine='flat' for the large-cluster scale tier")
     q = _round_up(pp, 128)
 
     pm = np.asarray(p.pod_mask)

@@ -68,6 +68,22 @@ def sanitize_scores(raw_scores):
     return jnp.where(jnp.isfinite(scores), scores, jnp.zeros_like(scores))
 
 
+def guard_scores(raw_scores, gate, numeric_flags, *, enabled: bool):
+    """The engines' per-invocation watchdog step in one call: fold this
+    invocation's violation bits into the sticky carry mask, then sanitize.
+    Returns ``(scores, numeric_flags)`` — unchanged when ``enabled`` is
+    False (Python-static, zero ops on the disabled path). Shared by the
+    exact and flat engines so the guard semantics cannot drift; the score
+    vector's length is irrelevant (flags are per-EVENT, any NaN anywhere
+    in the scored view flags it), so the same call guards the dense [N]
+    sweep and the prefiltered [k] candidate view — no index translation
+    through the top-k gather is needed or wanted."""
+    if not enabled:
+        return raw_scores, numeric_flags
+    return (sanitize_scores(raw_scores),
+            numeric_flags | score_flags(raw_scores, gate))
+
+
 def fitness_flags(score):
     """i32 violation bitmask for a final fitness scalar: NaN, Inf, or
     outside the paper's [0, 1] fitness range."""

@@ -85,7 +85,12 @@ class TraceBuffer(NamedTuple):
     # data column indices
     COL_KIND = 0  # TRACE_CREATE / TRACE_DELETE / TRACE_RETRY
     COL_POD = 1  # original input-order pod id
-    COL_NODE = 2  # chosen node (-1 = failed/none); held node on DELETE
+    # chosen node (-1 = failed/none); held node on DELETE. ALWAYS the
+    # GLOBAL node index: under SimConfig.node_prefilter_k the winner is
+    # gathered back through the candidate list before the row is written
+    # (the local top-k slot never leaks), so cli trace-diff rows stay
+    # comparable across prefilter configs.
+    COL_NODE = 2
     COL_PENDING = 3  # post-step pending event count
     COL_FREE_CPU = 4  # post-step cluster-wide free aggregates
     COL_FREE_MEM = 5
@@ -171,7 +176,15 @@ class FlatState(NamedTuple):
     """The flat engine's while_loop carry (fks_tpu.sim.flat): slot-per-pod
     event queue in tie-rank order + the SAME cluster/evaluator fields as
     SimState. Per-pod arrays are in SLOT (tie-rank) order; finalize
-    un-permutes them back to input order."""
+    un-permutes them back to input order.
+
+    Dtype annotations below are the defaults. Under ``SimConfig.
+    state_pack`` the ``aux`` / ``aux_gpus`` / ``wait_hist`` / ``gpu_left``
+    / ``gpu_milli_left`` columns narrow to 16 bits when their full value
+    range provably fits at the workload's shape (see
+    ``flat._pack_dtypes``) — exact integer packing, never accumulators,
+    so results are bit-identical; finalize widens everything back so
+    SimResult dtypes are config-independent."""
 
     # event queue: one slot per pod, slots sorted by tie_rank
     ev_time: Any  # i32[Q]; INF = no pending event

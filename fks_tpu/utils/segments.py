@@ -14,6 +14,19 @@ from __future__ import annotations
 from typing import Any
 
 
+def segment_budget(max_steps: int, seg_steps: int, *, slack: int = 1) -> int:
+    """Dispatch budget for a segmented host loop: enough segments to
+    consume ``max_steps`` events on a lane that never goes idle, plus
+    ``slack`` observation segments. The classic loop needs slack 1 (one
+    extra dispatch to OBSERVE the all-done flag after the draining
+    segment); the double-buffered loop needs slack 2 (its flag lags one
+    segment behind the dispatch front — see
+    ``sim.flat.make_segmented_population_run``). Exhausting the budget
+    with lanes still active means the step/cond predicates diverged, and
+    callers raise rather than spin."""
+    return -(-max_steps // seg_steps) + slack
+
+
 def validate_seg_steps(value: Any, *, source: str = "seg_steps",
                        zero_disables: bool = True) -> int:
     """Validate a segment length and return it as an int.

@@ -79,6 +79,11 @@ METRIC_KIND_REQUIRED: Dict[str, Tuple[str, ...]] = {
     # and what the rung cost in device wall seconds
     "budget_rung": ("generation", "rung", "entered", "survived",
                     "device_seconds"),
+    # large-cluster scale tier (bench stage_scale1k / cli scale): the
+    # completion-run throughput record must say what shape ran and which
+    # scale knobs (prefilter / packed dtypes) produced the number
+    "scale_tier": ("nodes", "pods", "events_per_sec",
+                   "node_prefilter_k", "state_pack"),
 }
 
 #: an OpenMetrics sample line: name, optional {labels}, value, optional ts

@@ -15,6 +15,13 @@ itself on the default trace must report zero divergence (exit 0). The
 decision-trace instrument comparing an engine to itself and finding a
 difference means the trace capture or alignment is broken — its verdicts
 on real engine pairs would be noise. Recorded as ``trace_gate``.
+
+A SCALE GATE follows: a small ``cli scale`` run with the scale-tier
+knobs on (top-k node prefiltering + packed state dtypes, flat engine)
+must complete and exit 0 — the cheap end-to-end check that the
+large-cluster path stays wired before the slow-marked 1k-node smoke
+test (tests/test_scale_tier.py) pays for the real shape. Recorded as
+``scale_gate``.
 """
 from __future__ import annotations
 
@@ -65,6 +72,22 @@ def trace_gate() -> dict:
     return {"ok": ok, **detail}
 
 
+def scale_gate() -> dict:
+    """Scale-tier smoke: a small ``cli scale`` run with prefiltering and
+    packed state dtypes must complete (exit 0). Returns {"ok": bool, ...}."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "-m", "fks_tpu.cli", "scale", "--cpu",
+         "--nodes", "64", "--pods", "512", "--pop", "2",
+         "--prefilter-k", "8", "--state-pack", "--engine", "flat"],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=600)
+    ok = proc.returncode == 0
+    detail = {"rc": proc.returncode}
+    if not ok:
+        detail["err"] = (proc.stderr or proc.stdout or "")[-500:]
+    return {"ok": ok, **detail}
+
+
 def main() -> int:
     rev = subprocess.run(["git", "rev-parse", "--short", "HEAD"],
                          capture_output=True, text=True, cwd=REPO
@@ -75,6 +98,9 @@ def main() -> int:
     tgate = trace_gate()
     if not tgate["ok"]:
         print(f"TRACE GATE FAILED: {tgate}", file=sys.stderr)
+    sgate = scale_gate()
+    if not sgate["ok"]:
+        print(f"SCALE GATE FAILED: {sgate}", file=sys.stderr)
     t0 = time.time()
     proc = subprocess.run(
         [sys.executable, "-m", "pytest", "tests/", "-q",
@@ -85,11 +111,11 @@ def main() -> int:
     summary = tail[0] if tail else ""
     counts = {k: int(v) for v, k in re.findall(
         r"(\d+) (passed|failed|error|skipped|deselected|xfailed)", summary)}
-    gates_ok = gate["ok"] and tgate["ok"]
+    gates_ok = gate["ok"] and tgate["ok"] and sgate["ok"]
     rc = proc.returncode if gates_ok else (proc.returncode or 1)
     row = {"ts": round(time.time(), 1), "rev": rev, "rc": rc,
            "wall_s": wall, **counts, "obs_gate": gate,
-           "trace_gate": tgate, "summary": summary}
+           "trace_gate": tgate, "scale_gate": sgate, "summary": summary}
     os.makedirs(os.path.dirname(OUT), exist_ok=True)
     with open(OUT, "a") as f:
         f.write(json.dumps(row) + "\n")
