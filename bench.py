@@ -974,6 +974,127 @@ def stage_scale1k(gate: str = "") -> int:
     return rc
 
 
+def stage_serve(gate: str = "") -> int:
+    """CPU subprocess: champion-serving headline (fks_tpu.serve) — the
+    cold/warm split the serving tier exists for. Builds a ServeEngine
+    (latest repo champion, synthetic cluster, flat engine) with a single
+    pod bucket and lane buckets covering batch sizes 1/8/64, then
+    measures:
+
+    - ``serve_cold_seconds``: the first batch-1 answer, compile included
+      (what a cold process pays before the bucket is warm);
+    - ``serve_p50_ms`` / ``serve_p99_ms``: per-answer wall latency over
+      repeated warm batch-1 queries;
+    - ``serve_qps`` (+ per-batch-size breakdown): answers/sec at batch
+      sizes 1, 8 and 64 — the headline is the best observed, i.e. the
+      coalescer's payoff at full occupancy;
+    - ``steady_state_recompiles``: backend compiles observed during the
+      warm passes — the zero-recompile contract, gated at 0 here.
+    """
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    from fks_tpu.data.synthetic import synthetic_workload
+    from fks_tpu.funsearch import template
+    from fks_tpu.obs import CompileWatcher
+    from fks_tpu.serve import (
+        ChampionSpec, ServeEngine, ShapeEnvelope, latest_champion,
+        load_champion,
+    )
+
+    global _RECORDER
+    _RECORDER = _controller_recorder()
+    watcher = CompileWatcher().install()
+    nodes = int(os.environ.get("FKS_BENCH_SERVE_NODES", "32"))
+    qpods = int(os.environ.get("FKS_BENCH_SERVE_PODS", "24"))
+    reps = int(os.environ.get("FKS_BENCH_SERVE_REPS", "20"))
+    batches = (1, 8, 64)
+
+    champ_path = latest_champion()
+    champion = (load_champion(champ_path) if champ_path else
+                ChampionSpec(code=template.fill_template("score = 1000")))
+    # one pod bucket (every query is qpods-sized) keeps the stage about
+    # the batch axis; lane buckets must cover the largest batch size
+    bucket = max(32, qpods)
+    envelope = ShapeEnvelope(max_pods=bucket, min_pod_bucket=bucket,
+                             max_batch=max(batches))
+    wl = synthetic_workload(nodes, 4 * qpods, seed=7)
+    engine = ServeEngine(champion, wl, envelope=envelope, engine="flat")
+    base = engine.base_pods
+    queries = [[dict(base[(i + j) % len(base)]) for j in range(qpods)]
+               for i in range(max(batches))]
+    log(f"serve stage: {nodes} nodes, {qpods}-pod queries, champion "
+        f"score={champion.score:.4f} tier={engine.policy_tier}")
+
+    # cold: first batch-1 answer, compile included
+    t0 = time.perf_counter()
+    engine.answer_batch([queries[0]])
+    cold_s = time.perf_counter() - t0
+    engine.warmup(lane_buckets=[engine.envelope.lanes_for(b)
+                                for b in batches])
+    # prime each batch size once: the AOT executables are already warm,
+    # but the EAGER host-side query stacking compiles its tiny stack/pad
+    # programs on first use of each batch shape — those are part of the
+    # cold cost, not a warm-path leak
+    for b in batches:
+        engine.answer_batch(queries[:b])
+    compile_s = watcher.backend_compile_seconds
+    compiles_warm = watcher.backend_compile_count
+
+    # warm batch-1 latency distribution
+    lat_ms = []
+    for i in range(reps):
+        t0 = time.perf_counter()
+        engine.answer_batch([queries[i % len(queries)]])
+        lat_ms.append((time.perf_counter() - t0) * 1e3)
+    p50 = float(np.percentile(lat_ms, 50))
+    p99 = float(np.percentile(lat_ms, 99))
+
+    # throughput per batch size (the batch axis is nearly free, so qps
+    # should scale with occupancy until the vmap saturates the host)
+    qps = {}
+    for b in batches:
+        n_rounds = max(1, reps // 4)
+        t0 = time.perf_counter()
+        for _ in range(n_rounds):
+            engine.answer_batch(queries[:b])
+        qps[b] = b * n_rounds / (time.perf_counter() - t0)
+    recompiles = watcher.backend_compile_count - compiles_warm
+    log(f"cold {cold_s:.2f}s; warm p50 {p50:.1f}ms p99 {p99:.1f}ms; "
+        f"qps {' '.join(f'b{b}={qps[b]:.1f}' for b in batches)}; "
+        f"recompiles in warm passes: {recompiles}")
+
+    payload = {
+        "serve_cold_seconds": round(cold_s, 3),
+        "serve_p50_ms": round(p50, 3),
+        "serve_p99_ms": round(p99, 3),
+        "serve_qps": round(max(qps.values()), 2),
+        **{f"serve_qps_b{b}": round(v, 2) for b, v in qps.items()},
+        "steady_state_recompiles": recompiles,
+        "backend_compiles": watcher.backend_compile_count,
+        "compile_seconds": round(compile_s, 3),
+        "nodes": nodes, "query_pods": qpods, "reps": reps,
+        "engine": "flat",
+        "policy_tier": engine.policy_tier,
+        "node_prefilter_k": engine.prefilter_k,
+        "champion_score": round(champion.score, 4),
+    }
+    _record("metric", "bench_stage", payload, stage="serve",
+            platform="cpu")
+    rc = 0
+    if recompiles:
+        log(f"FAIL: {recompiles} recompiles on the warm path — a bucket "
+            "shape leaked out of the AOT cache")
+        rc = 1
+    if gate:
+        rc = rc or _gate(gate, payload)
+    _record("finish", "ok" if rc == 0 else "fail")
+    _record("close")
+    print(json.dumps(payload))
+    return rc
+
+
 # ------------------------------------------------------------ controller
 
 
@@ -1068,6 +1189,10 @@ def main():
         # standalone large-cluster scale-tier headline (1k nodes x 100k
         # pods, flat CPU); same self-contained --gate contract as budget
         return stage_scale1k(gate)
+    if stage == "serve":
+        # standalone champion-serving headline (cold vs warm latency,
+        # batched qps, zero-recompile warm path); same --gate contract
+        return stage_serve(gate)
 
     # controller (hard deadline so the driver always gets the JSON line;
     # every stage/probe timeout below is clamped to the remaining budget)

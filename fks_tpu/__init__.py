@@ -17,6 +17,9 @@ ttanv/funsearch-kubernetes-simulator (reference at /root/reference):
                            (reference: ProcessPoolExecutor in funsearch_integration.py)
 - ``fks_tpu.funsearch`` -- LLM codegen, sandbox/transpiler, evolution controller,
                            persistence (reference: funsearch/)
+- ``fks_tpu.serve``     -- champion serving: pinned champion -> warm AOT-compiled
+                           what-if query engine with request batching (no
+                           reference analogue; the production tier)
 - ``fks_tpu.utils``     -- config, logging, profiling.
 
 The simulation core reproduces the reference's observable semantics exactly

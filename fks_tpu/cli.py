@@ -13,6 +13,7 @@ Usage:
     python -m fks_tpu.cli simulate --policy best_fit [--validate]
     python -m fks_tpu.cli evolve [--config F] [--fake-llm] [--checkpoint F]
     python -m fks_tpu.cli scale [--nodes-count N] [--pods-count P] [--pop C]
+    python -m fks_tpu.cli serve [--champion F] [--queries F | --http PORT]
     python -m fks_tpu.cli report RUN_DIR
     python -m fks_tpu.cli export-metrics RUN_DIR [--out F]
     python -m fks_tpu.cli watch RUN_DIR [--interval S] [--once]
@@ -32,6 +33,7 @@ from __future__ import annotations
 
 import argparse
 import contextlib
+import dataclasses
 import json
 import sys
 
@@ -414,7 +416,7 @@ def cmd_scale(args):
         make_population_eval, make_sharded_eval, pad_population,
         population_mesh,
     )
-    from fks_tpu.sim.engine import SimConfig
+    from fks_tpu.sim.engine import SimConfig, resolve_auto_prefilter
     from fks_tpu.utils import ThroughputMeter
 
     with _flight_recorder(args, "scale") as rec, \
@@ -440,7 +442,15 @@ def cmd_scale(args):
             obs.record_devices(rec)
         pop = parametric.init_population(
             jax.random.PRNGKey(args.seed), args.pop, noise=0.1)
-        cfg = SimConfig(node_prefilter_k=getattr(args, "prefilter_k", 0),
+        pk_override = getattr(args, "prefilter_k", None)
+        if args.engine == "fused" and pk_override is None:
+            pk = 0  # the fused kernel has no prefilter path; don't probe
+        else:
+            pk = resolve_auto_prefilter(
+                parametric.score, jax.tree_util.tree_map(lambda x: x[0], pop),
+                wl.cluster.n_padded, wl.cluster.g_padded,
+                override=pk_override, recorder=rec)
+        cfg = SimConfig(node_prefilter_k=pk,
                         state_pack=getattr(args, "state_pack", False))
         devices = jax.devices()
         try:
@@ -479,6 +489,7 @@ def cmd_scale(args):
             "score_min": round(float(scores.min()), 4),
             "score_max": round(float(scores.max()), 4),
             "node_prefilter_k": cfg.node_prefilter_k,
+            "prefilter_auto": pk_override is None,
             "state_pack": cfg.state_pack,
             "openb_nodes": node_park is not None,
         }
@@ -498,24 +509,33 @@ def cmd_scale(args):
                       f"candidates; lower --code-pop", file=sys.stderr)
                 return 2
             stacked = vm.stack_programs(progs[: args.code_pop])
+            # the code tier probes its OWN policy cost: VM register
+            # programs are the expensive case the prefilter exists for,
+            # so auto may choose k>0 here while the parametric tier above
+            # stayed dense
+            pk_code = resolve_auto_prefilter(
+                vm.score_static, progs[0], c.n_padded, c.g_padded,
+                override=pk_override, recorder=rec)
+            ccfg = dataclasses.replace(cfg, node_prefilter_k=pk_code)
             if len(devices) > 1:
                 cpadded, creal = pad_population(stacked, mesh)
                 cev = make_sharded_code_eval(
-                    wl, mesh, cfg=cfg, elite_k=min(4, args.code_pop),
+                    wl, mesh, cfg=ccfg, elite_k=min(4, args.code_pop),
                     engine=code_engine)
                 with span("code_eval", code_population=args.code_pop) as ct:
                     cres = ct.sync(cev(cpadded, creal)[0])
             else:
                 mod = get_engine(code_engine)
-                crun = mod.make_population_run_fn(wl, vm.score_static, cfg)
+                crun = mod.make_population_run_fn(wl, vm.score_static, ccfg)
                 with span("code_eval", code_population=args.code_pop) as ct:
-                    cres = ct.sync(crun(stacked, mod.initial_state(wl, cfg)))
+                    cres = ct.sync(crun(stacked, mod.initial_state(wl, ccfg)))
             cscores = cres.policy_score[: args.code_pop]
             cmeter = ThroughputMeter()
             cmeter.add(args.code_pop, ct.seconds)
             out.update({
                 "code_population": args.code_pop,
                 "code_engine": code_engine,
+                "code_prefilter_k": pk_code,
                 "code_wall_s": round(ct.seconds, 3),
                 "code_evals_per_sec": round(cmeter.rate, 3),
                 "code_score_max": round(float(cscores.max()), 4),
@@ -525,6 +545,91 @@ def cmd_scale(args):
         rec.metric("scale", out)
     print(json.dumps(out, indent=2))
     return 0
+
+
+def cmd_serve(args):
+    """Serve a pinned champion as a warm what-if query engine
+    (fks_tpu.serve): build or load an artifact, optionally pre-compile
+    every shape bucket, then answer queries over stdin/JSONL, a file, or
+    a localhost HTTP listener. ``--selftest N`` instead runs the
+    batched-vs-unbatched exact-parity sweep and exits nonzero on any
+    drift — the run_full_suite serve gate."""
+    _apply_platform_flags(args)
+    from fks_tpu import obs
+    from fks_tpu.serve import (
+        ServeEngine, ServeService, ShapeEnvelope, latest_champion,
+        load_champion, selftest,
+    )
+    from fks_tpu.serve.service import run_http, run_jsonl
+
+    with _flight_recorder(args, "serve") as rec, obs.watch_compiles(rec):
+        if args.artifact:
+            engine = ServeEngine.load(args.artifact, recorder=rec)
+        else:
+            champ_path = args.champion or latest_champion()
+            if not champ_path:
+                print("error: no champion JSON found — pass --champion or "
+                      "evolve one first (policies/discovered/)",
+                      file=sys.stderr)
+                return 2
+            champion = load_champion(champ_path)
+            _, wl = _parse_workload(args)
+            engine = ServeEngine(
+                champion, wl,
+                envelope=ShapeEnvelope(max_pods=args.max_pods,
+                                       max_batch=args.max_batch),
+                engine=args.engine,
+                prefilter_k=getattr(args, "prefilter_k", None),
+                state_pack=getattr(args, "state_pack", False),
+                recorder=rec)
+        if rec.enabled:
+            rec.annotate_meta(
+                engine=engine.engine_name,
+                champion={"score": engine.champion.score,
+                          "source": engine.champion.source},
+                envelope=engine.envelope.to_json(),
+                policy_tier=engine.policy_tier,
+                prefilter_k=engine.prefilter_k)
+        print(f"serving champion score={engine.champion.score:.4f} "
+              f"tier={engine.policy_tier} engine={engine.engine_name} "
+              f"prefilter_k={engine.prefilter_k}", file=sys.stderr)
+        if args.save_artifact:
+            if args.warmup:
+                engine.warmup()
+            path = engine.save(args.save_artifact)
+            print(f"artifact saved: {path}", file=sys.stderr)
+        if args.selftest:
+            result = selftest(engine, count=args.selftest,
+                              pods_per_query=args.pods_per_query,
+                              tol=args.audit_tol)
+            print(json.dumps(result, indent=2))
+            return 0 if result["ok"] else 1
+        if args.warmup and not args.save_artifact:
+            n = engine.warmup()
+            print(f"warm: {n} bucket programs compiled", file=sys.stderr)
+        if args.save_artifact and not (args.queries or args.http):
+            return 0  # artifact-build invocation, nothing to serve
+        service = ServeService(engine, recorder=rec,
+                               max_wait_s=args.max_wait_ms / 1e3,
+                               audit_every=args.audit_every,
+                               audit_tol=args.audit_tol)
+        try:
+            if args.http:
+                print(f"listening on http://127.0.0.1:{args.http} "
+                      "(POST /query, GET /stats, GET /healthz)",
+                      file=sys.stderr)
+                run_http(service, args.http)
+                errors = 0
+            elif args.queries and args.queries != "-":
+                with open(args.queries) as f:
+                    errors = run_jsonl(service, f)
+            else:
+                errors = run_jsonl(service)  # stdin
+        finally:
+            service.close()
+            summary = service.summary()
+            print(json.dumps(summary), file=sys.stderr)
+    return 1 if errors else 0
 
 
 def cmd_report(args):
@@ -840,11 +945,15 @@ def main(argv=None) -> int:
                     type=int, default=100000)
     sc.add_argument("--pop", type=int, default=8)
     sc.add_argument("--seed", type=int, default=0)
-    sc.add_argument("--prefilter-k", type=int, default=0,
+    sc.add_argument("--prefilter-k", type=int, default=None,
                     help="SimConfig.node_prefilter_k: score only the "
                          "top-k statically-feasible nodes per event "
                          "(0 = dense scan, bit-identical to the default "
-                         "program; flat engine only)")
+                         "program). Default: auto — a cheap policy-cost "
+                         "probe enables the prefilter for expensive "
+                         "policies on big node parks and leaves cheap "
+                         "parametric scoring dense "
+                         "(fks_tpu.sim.engine.resolve_auto_prefilter)")
     sc.add_argument("--state-pack", action="store_true",
                     help="SimConfig.state_pack: narrow flat-engine carry "
                          "columns to 16-bit where the value range "
@@ -864,6 +973,53 @@ def main(argv=None) -> int:
                          "single-device vmap; this replaces setting "
                          "XLA_FLAGS=--xla_force_host_platform_device_count)")
     sc.set_defaults(fn=cmd_scale)
+
+    sv = sub.add_parser("serve",
+                        help="serve a pinned champion as a warm what-if "
+                             "query engine (JSONL/HTTP)", parents=[common])
+    _add_trace_flags(sv)
+    sv.add_argument("--champion", default="",
+                    help="champion JSON from the evolution ledger "
+                         "(default: best under policies/discovered/)")
+    sv.add_argument("--artifact", default="",
+                    help="load a saved serve artifact directory instead of "
+                         "building from --champion/--trace")
+    sv.add_argument("--save-artifact", default="",
+                    help="persist the built engine (artifact.json + XLA "
+                         "compilation cache) to this directory")
+    sv.add_argument("--max-pods", type=int, default=1024,
+                    help="shape envelope: largest query (pods per what-if)")
+    sv.add_argument("--max-batch", type=int, default=8,
+                    help="shape envelope: largest coalesced request batch")
+    sv.add_argument("--max-wait-ms", type=float, default=5.0,
+                    help="flush policy: max ms the oldest pending request "
+                         "waits for batch-mates (default 5)")
+    sv.add_argument("--prefilter-k", type=int, default=None,
+                    help="SimConfig.node_prefilter_k override (default: "
+                         "auto via the policy-cost probe)")
+    sv.add_argument("--state-pack", action="store_true",
+                    help="SimConfig.state_pack for the serving engine")
+    sv.add_argument("--warmup", action="store_true",
+                    help="pre-compile every (lane, pod) shape bucket "
+                         "before answering")
+    sv.add_argument("--queries", default="",
+                    help="answer request JSONL from this file ('-' or "
+                         "empty = stdin), one answer line per request")
+    sv.add_argument("--http", type=int, default=0,
+                    help="serve a localhost HTTP listener on this port "
+                         "instead of JSONL")
+    sv.add_argument("--selftest", type=int, default=0,
+                    help="run the batched-vs-unbatched exact-parity sweep "
+                         "with N queries and exit (nonzero on drift) — "
+                         "the run_full_suite serve gate")
+    sv.add_argument("--pods-per-query", type=int, default=4,
+                    help="query size for --selftest (default 4)")
+    sv.add_argument("--audit-every", type=int, default=0,
+                    help="ParitySentinel-audit every Nth served answer "
+                         "against the unbatched exact engine (0 = off)")
+    sv.add_argument("--audit-tol", type=float, default=1e-5,
+                    help="audit/selftest score drift tolerance")
+    sv.set_defaults(fn=cmd_serve)
 
     r = sub.add_parser("report",
                        help="summarize a flight-recorder run directory")

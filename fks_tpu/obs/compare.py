@@ -60,6 +60,11 @@ DEFAULT_THRESHOLDS: Dict[str, Threshold] = {
     # large-cluster scale tier (bench stage_scale1k): 1k-node x 100k-pod
     # completion throughput on the flat engine must not drop >10%
     "scale1k_events_per_sec": Threshold(higher_is_better=True, rel=0.10),
+    # champion serving (bench stage_serve): warm tail latency must not
+    # inflate more than 25% (with a 2 ms noise floor — CPU timer jitter
+    # at millisecond scale), and batched throughput must not drop >10%
+    "serve_p99_ms": Threshold(higher_is_better=False, rel=0.25, abs_tol=2.0),
+    "serve_qps": Threshold(higher_is_better=True, rel=0.10),
 }
 
 
@@ -93,10 +98,14 @@ def _from_run_dir(run_dir: str) -> Dict[str, float]:
             continue
         for key in ("evals_per_sec", "code_evals_per_sec",
                     "budget_speedup", "budget_champion_match",
-                    "scale1k_events_per_sec"):
+                    "scale1k_events_per_sec", "serve_qps"):
             v = _num(m.get(key))
             if v is not None:
                 out[key] = max(out.get(key, 0.0), v)
+        # latency: best (lowest) observation, mirroring serve_qps's max
+        v = _num(m.get("serve_p99_ms"))
+        if v is not None:
+            out["serve_p99_ms"] = min(out.get("serve_p99_ms", v), v)
         v = _num(m.get("compile_seconds"))
         if v is not None:
             out["compile_seconds"] = out.get("compile_seconds", 0.0) + v
@@ -127,11 +136,12 @@ def _from_jsonl(path: str) -> Dict[str, float]:
         for key in ("evals_per_sec", "code_evals_per_sec",
                     "compile_seconds", "best_score", "median_score",
                     "parity_max_drift", "budget_speedup",
-                    "budget_champion_match", "scale1k_events_per_sec"):
+                    "budget_champion_match", "scale1k_events_per_sec",
+                    "serve_p99_ms", "serve_qps"):
             v = _num(rec.get(key))
             if v is None:
                 continue
-            if key == "compile_seconds":
+            if key in ("compile_seconds", "serve_p99_ms"):
                 out[key] = min(out.get(key, v), v)
             else:
                 out[key] = max(out.get(key, v), v)

@@ -268,6 +268,37 @@ class ParitySentinel:
                        f"by {gap:.3g} (tol {self.tol:.3g})")
         return stats
 
+    def audit_served(self, request_id: str, served_score: float,
+                     reference_score: float, placements_match: bool = True,
+                     source: str = "serve") -> bool:
+        """Audit one SERVED answer (fks_tpu.serve) against the unbatched
+        exact-engine reference the serving engine computed for the same
+        query. No evaluator needed (``ParitySentinel(None, ...)`` works):
+        both scores arrive precomputed; the sentinel contributes the
+        tolerance policy, the drift bookkeeping, and the shared
+        ``parity`` metric / ``alert`` event plumbing so serving drift
+        lands in the same dashboards as search drift. Returns True when
+        the answer passes."""
+        d = abs(float(served_score) - float(reference_score))
+        ok = d <= self.tol and bool(placements_match)
+        self.checked += 1
+        self.max_drift = max(self.max_drift, d)
+        self.recorder.metric("parity", {
+            "generation": -1, "checked": 1, "failed": 0,
+            "max_drift": round(d, 8), "tol": self.tol, "source": source,
+            "request_id": str(request_id),
+            "placements_match": bool(placements_match)})
+        if not ok:
+            self.alerts += 1
+            why = (f"fitness drift {d:.3g} exceeds tolerance "
+                   f"{self.tol:.3g}" if d > self.tol
+                   else "placements diverge from the exact reference")
+            self.recorder.event(
+                "alert", source="serve_parity",
+                request_id=str(request_id), max_drift=round(d, 8),
+                tol=self.tol, detail=f"served answer {request_id}: {why}")
+        return ok
+
     def _diff_offender(self, code: str, generation: int) -> Optional[dict]:
         """Best-effort root-cause localization for an alert: trace-diff
         the worst offender's search-tier evaluation against the exact

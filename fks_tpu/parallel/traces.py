@@ -29,9 +29,11 @@ from fks_tpu.sim.engine import SimConfig
 from fks_tpu.sim.evaluator import max_snapshot_count, snapshot_trigger_table
 
 
-def _strip_ids(wl: Workload) -> Workload:
+def strip_ids(wl: Workload) -> Workload:
     """Drop host-side id tuples (static pytree meta) so same-shape workloads
-    share one treedef and can stack under vmap."""
+    share one treedef and can stack under vmap. Public: the serving tier
+    (fks_tpu.serve.batcher) stacks per-query workloads with exactly this
+    normalization so queries match the AOT-compiled example's treedef."""
     return Workload(
         cluster=ClusterArrays(**{
             **{f: getattr(wl.cluster, f) for f in (
@@ -44,6 +46,9 @@ def _strip_ids(wl: Workload) -> Workload:
                 "duration", "tie_rank", "pod_mask")},
             "pod_ids": ()}),
         faults=wl.faults)
+
+
+_strip_ids = strip_ids  # internal alias, kept for existing call sites
 
 
 def stack_traces(workloads: Sequence[Workload], cfg: SimConfig,

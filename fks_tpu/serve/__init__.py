@@ -1,0 +1,23 @@
+"""Champion serving: pinned champion -> warm, no-recompile query engine.
+
+- artifact: champion loading, shape envelope, AOT ServeEngine, save/load.
+- batcher: query->workload construction, lane stacking, request coalescer.
+- service: request/metrics layer, JSONL + localhost HTTP fronts, selftest.
+"""
+from fks_tpu.serve.artifact import (
+    ChampionSpec, ServeEngine, ShapeEnvelope, enable_persistent_cache,
+    latest_champion, load_champion,
+)
+from fks_tpu.serve.batcher import (
+    DEFAULT_DURATION, POD_FIELDS, RequestBatcher, build_query_workload,
+    pods_to_dicts, stack_queries, validate_query_pods,
+)
+from fks_tpu.serve.service import ServeService, selftest
+
+__all__ = [
+    "ChampionSpec", "ServeEngine", "ShapeEnvelope",
+    "enable_persistent_cache", "latest_champion", "load_champion",
+    "DEFAULT_DURATION", "POD_FIELDS", "RequestBatcher",
+    "build_query_workload", "pods_to_dicts", "stack_queries",
+    "validate_query_pods", "ServeService", "selftest",
+]

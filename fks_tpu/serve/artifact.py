@@ -1,0 +1,556 @@
+"""Champion-serving artifacts: pinned champion -> warm AOT query engine.
+
+The evolution loop persists champions as JSON in the ledger format
+(``policies/discovered/funsearch_*.json``); this module turns one of them
+plus a declared shape envelope into a **no-recompile** query engine:
+
+- ``load_champion`` / ``latest_champion``: read a champion (single-dict
+  or top-policies-list ledger files) back off disk.
+- ``ShapeEnvelope``: the declared serving envelope — max pods per query,
+  max batch, the pod-bucket ladder queries pad to, the gpu_milli range
+  the shared wait histogram must cover. Shape-bucketing is what makes
+  "warm" possible: a finite set of (lane_bucket, pod_bucket) shapes,
+  each compiled exactly once.
+- ``ServeEngine``: per (lane_bucket, pod_bucket) combination, the engine
+  step/finalize pipeline is AOT-compiled via
+  ``jax.jit(fn).lower(example).compile()`` with the champion's policy
+  baked in as closure constants and the stacked workload/ktable/state as
+  ARGUMENTS — the inverse of ``make_trace_batch_eval``'s closure capture,
+  which would re-trace per batch. Calling the resulting ``Compiled``
+  executable can never trigger compilation, so the zero-recompile warm
+  path is structural, not best-effort. ``jax.export`` does not exist on
+  the installed jax (0.4.37), so cross-process persistence rides the JAX
+  compilation cache instead (``enable_persistent_cache``): a reloaded
+  artifact re-lowers but fetches the XLA binary from the cache.
+
+The engine answers are plain dicts (score, scheduled count, per-pod
+placements) so the service layer can JSON them straight out.
+"""
+from __future__ import annotations
+
+import dataclasses
+import glob
+import json
+import os
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fks_tpu import obs
+from fks_tpu.data.entities import ClusterArrays, Workload
+from fks_tpu.parallel.mesh import pad_population
+from fks_tpu.serve.batcher import (
+    build_query_workload, pods_to_dicts, stack_queries, validate_query_pods,
+)
+from fks_tpu.sim import get_engine
+from fks_tpu.sim.engine import (
+    SimConfig, resolve_auto_prefilter, run_batched_lanes,
+)
+from fks_tpu.sim.evaluator import max_snapshot_count
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+#: where the evolution loop lands its champion JSONs
+CHAMPION_DIR = os.path.join(REPO, "policies", "discovered")
+
+ARTIFACT_VERSION = 1
+
+
+# ---------------------------------------------------------------- champions
+
+
+@dataclasses.dataclass(frozen=True)
+class ChampionSpec:
+    """A pinned champion: the evolved source plus its ledger provenance."""
+
+    code: str
+    score: float = 0.0
+    generation: int = -1
+    timestamp: str = ""
+    source: str = ""  # file path it was loaded from, "" for in-memory
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_json(cls, doc: dict, source: str = "") -> "ChampionSpec":
+        return cls(code=doc["code"], score=float(doc.get("score", 0.0)),
+                   generation=int(doc.get("generation", -1)),
+                   timestamp=str(doc.get("timestamp", "")), source=source)
+
+
+def load_champion(path: str) -> ChampionSpec:
+    """Load a champion from an evolution-ledger JSON: either a single
+    champion dict (``save_best_policy``) or a top-policies list
+    (``save_top_policies`` — the best-scoring entry wins)."""
+    with open(path) as f:
+        doc = json.load(f)
+    if isinstance(doc, list):
+        if not doc:
+            raise ValueError(f"{path}: empty top-policies list")
+        doc = max(doc, key=lambda d: float(d.get("score", 0.0)))
+    if "code" not in doc:
+        raise ValueError(f"{path}: no 'code' field — not a champion JSON")
+    return ChampionSpec.from_json(doc, source=path)
+
+
+def latest_champion(directory: str = "") -> Optional[str]:
+    """Path of the best champion JSON under ``directory`` (default: the
+    repo's discovered-policies ledger), by score then filename; None when
+    the ledger is empty."""
+    directory = directory or CHAMPION_DIR
+    best: Optional[Tuple[float, str]] = None
+    for path in sorted(glob.glob(os.path.join(directory, "*.json"))):
+        try:
+            spec = load_champion(path)
+        except (ValueError, KeyError, OSError, json.JSONDecodeError):
+            continue  # one malformed file must not hide the ledger
+        if best is None or spec.score > best[0]:
+            best = (spec.score, path)
+    return best[1] if best else None
+
+
+# ----------------------------------------------------------------- envelope
+
+
+@dataclasses.dataclass(frozen=True)
+class ShapeEnvelope:
+    """The declared serving envelope: every shape the warm engine must
+    answer without compiling. Queries pad UP to the nearest bucket, so
+    the compiled-program set is finite and enumerable (``warmup``)."""
+
+    max_pods: int = 1024       # largest query (pods per what-if)
+    max_batch: int = 8         # largest coalesced batch (lane bucket cap)
+    min_pod_bucket: int = 16   # smallest pod bucket
+    pod_bucket_growth: int = 4  # bucket ladder ratio
+    max_gpu_milli: int = 1000  # sizes the shared wait histogram
+
+    def __post_init__(self):
+        if self.max_pods < 1 or self.max_batch < 1:
+            raise ValueError("max_pods and max_batch must be >= 1")
+        if self.min_pod_bucket < 1 or self.pod_bucket_growth < 2:
+            raise ValueError("min_pod_bucket >= 1, pod_bucket_growth >= 2")
+
+    def pod_buckets(self) -> Tuple[int, ...]:
+        """The pod-bucket ladder: min_bucket * growth^i, clipped at
+        max_pods (the top bucket is max_pods itself when the ladder does
+        not land on it)."""
+        out: List[int] = []
+        b = self.min_pod_bucket
+        while b < self.max_pods:
+            out.append(b)
+            b *= self.pod_bucket_growth
+        out.append(self.max_pods)
+        # dedupe while preserving order (max_pods may equal the last rung)
+        return tuple(dict.fromkeys(out))
+
+    def pod_bucket_for(self, n_pods: int) -> int:
+        for b in self.pod_buckets():
+            if n_pods <= b:
+                return b
+        raise ValueError(
+            f"query with {n_pods} pods exceeds envelope max_pods "
+            f"{self.max_pods}")
+
+    def min_real_pods(self, bucket: int) -> int:
+        """Smallest real pod count routed to ``bucket`` (the previous
+        rung + 1; 1 for the smallest bucket). Sizes the bucket's fixed
+        snapshot-table width: tables grow as real pods shrink, and
+        routing guarantees no query below this count lands here."""
+        buckets = self.pod_buckets()
+        i = buckets.index(bucket)
+        return 1 if i == 0 else buckets[i - 1] + 1
+
+    def lane_buckets(self) -> Tuple[int, ...]:
+        """Lane (batch) buckets: powers of two up to max_batch, plus
+        max_batch itself."""
+        out = []
+        b = 1
+        while b < self.max_batch:
+            out.append(b)
+            b *= 2
+        out.append(self.max_batch)
+        return tuple(dict.fromkeys(out))
+
+    def lanes_for(self, n_queries: int) -> int:
+        for b in self.lane_buckets():
+            if n_queries <= b:
+                return b
+        raise ValueError(
+            f"batch of {n_queries} queries exceeds envelope max_batch "
+            f"{self.max_batch}; chunk it first")
+
+    @property
+    def wait_hist_size(self) -> int:
+        """Shared wait-histogram width covering the declared gpu_milli
+        range (the engine's own sizing rule, pinned so every bucket's
+        states share one shape)."""
+        return max(1001, self.max_gpu_milli + 2)
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_json(cls, doc: dict) -> "ShapeEnvelope":
+        return cls(**doc)
+
+
+# ---------------------------------------------------------- persistence
+
+
+def enable_persistent_cache(cache_dir: str) -> None:
+    """Point the JAX compilation cache at ``cache_dir`` with the size/time
+    floors dropped, so even small serve programs persist. jax 0.4.37 has
+    no ``jax.export``; this cache is the AOT persistence story — a
+    process that re-lowers the same program fetches the compiled binary
+    instead of re-running XLA."""
+    os.makedirs(cache_dir, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    for opt, val in (("jax_persistent_cache_min_compile_time_secs", 0.0),
+                     ("jax_persistent_cache_min_entry_size_bytes", -1)):
+        try:
+            jax.config.update(opt, val)
+        except AttributeError:  # option renamed on some jax versions
+            pass
+
+
+def _cluster_to_json(c: ClusterArrays) -> dict:
+    """Cluster arrays as JSON-serializable lists (clusters are small —
+    O(nodes) ints — so JSON keeps the artifact single-file-inspectable)."""
+    return {
+        "cpu_total": np.asarray(c.cpu_total).tolist(),
+        "mem_total": np.asarray(c.mem_total).tolist(),
+        "gpu_declared": np.asarray(c.gpu_declared).tolist(),
+        "num_gpus": np.asarray(c.num_gpus).tolist(),
+        "gpu_milli_total": np.asarray(c.gpu_milli_total).tolist(),
+        "gpu_mem_total": np.asarray(c.gpu_mem_total).tolist(),
+        "gpu_mask": np.asarray(c.gpu_mask).astype(int).tolist(),
+        "node_mask": np.asarray(c.node_mask).astype(int).tolist(),
+        "node_ids": list(c.node_ids),
+    }
+
+
+def _cluster_from_json(doc: dict) -> ClusterArrays:
+    i32 = lambda k: np.asarray(doc[k], np.int32)  # noqa: E731
+    return ClusterArrays(
+        cpu_total=i32("cpu_total"), mem_total=i32("mem_total"),
+        gpu_declared=i32("gpu_declared"), num_gpus=i32("num_gpus"),
+        gpu_milli_total=i32("gpu_milli_total"),
+        gpu_mem_total=i32("gpu_mem_total"),
+        gpu_mask=np.asarray(doc["gpu_mask"], bool),
+        node_mask=np.asarray(doc["node_mask"], bool),
+        node_ids=tuple(doc["node_ids"]),
+    )
+
+
+# ------------------------------------------------------------------ engine
+
+
+class ServeEngine:
+    """A pinned (champion, cluster, envelope) triple compiled for serving.
+
+    One AOT ``Compiled`` executable per (lane_bucket, pod_bucket)
+    combination, built on demand (or eagerly via ``warmup``) and cached
+    for the engine's lifetime. The executable's signature is
+    ``(workload[L,...], ktable[L,K], state0[L,...]) -> SimResult[L,...]``
+    — the batch contents are arguments, the policy is a constant, so the
+    warm path runs zero Python tracing and zero XLA compilation.
+
+    ``engine`` picks the simulation module ("exact" serves reference
+    semantics and is the parity default; "flat" trades the documented
+    retry-rule divergence for throughput). ``prefilter_k=None`` engages
+    the auto-enable heuristic (``sim.engine.resolve_auto_prefilter``).
+    """
+
+    def __init__(self, champion: ChampionSpec, workload: Workload, *,
+                 envelope: Optional[ShapeEnvelope] = None,
+                 engine: str = "exact",
+                 prefilter_k: Optional[int] = None,
+                 state_pack: bool = False,
+                 max_steps_factor: int = 8,
+                 recorder=None):
+        if engine == "fused":
+            raise ValueError(
+                "the fused kernel evaluates parametric populations only; "
+                "serve champions on 'exact' (parity default) or 'flat'")
+        self.champion = champion
+        self.cluster = workload.cluster
+        self.base_pods = pods_to_dicts(workload.pods)
+        self.envelope = envelope or ShapeEnvelope()
+        self.engine_name = engine
+        self.state_pack = bool(state_pack)
+        self.max_steps_factor = int(max_steps_factor)
+        self.recorder = recorder if recorder is not None else obs.get_recorder()
+        self._mod = get_engine(engine)
+        self._compiled: Dict[Tuple[int, int], Any] = {}
+        self.cold_compiles = 0
+
+        n, g = self.cluster.n_padded, self.cluster.g_padded
+        self.param_policy, self.params, self.policy_tier = \
+            self._resolve_policy(champion.code, n, g)
+        self.prefilter_k = resolve_auto_prefilter(
+            self.param_policy, self.params, n, g,
+            override=prefilter_k, recorder=self.recorder)
+
+    @staticmethod
+    def _resolve_policy(code: str, n: int, g: int):
+        """Champion source -> (param_policy, params, tier). VM lowering
+        first (register program as the param pytree — the population
+        tier's representation); candidates outside the VM vocabulary fall
+        back to direct transpile + jit closure. TranspileError (invalid
+        source) propagates: a broken champion is a caller error."""
+        from fks_tpu.funsearch import transpiler, vm
+
+        try:
+            prog = vm.compile_policy(code, n, g)
+            return vm.score_static, prog, "vm"
+        except vm.VMUnsupported:
+            policy = transpiler.transpile(code)
+            return (lambda _p, pod, nodes: policy(pod, nodes)), None, "jit"
+
+    # ----- bucket plumbing
+
+    def bucket_config(self, pod_bucket: int) -> SimConfig:
+        """The bucket's SimConfig — SHARED by the batched path and the
+        unbatched exact reference (``reference_answer``), so bucket
+        padding is part of the serving semantics, not a parity leak."""
+        return SimConfig(
+            max_steps=max(64, self.max_steps_factor * pod_bucket),
+            wait_hist_size=self.envelope.wait_hist_size,
+            node_prefilter_k=self.prefilter_k,
+            state_pack=self.state_pack,
+        )
+
+    def _klen(self, pod_bucket: int) -> int:
+        """Fixed snapshot-table width for the bucket, sized at the
+        SMALLEST real pod count routing can send here (tables grow as
+        real pods shrink; see ``ShapeEnvelope.min_real_pods``)."""
+        cfg = self.bucket_config(pod_bucket)
+        return max_snapshot_count(cfg.max_steps,
+                                  self.envelope.min_real_pods(pod_bucket),
+                                  cfg.snapshot_interval)
+
+    def _make_serve_fn(self, pod_bucket: int):
+        """The jittable batched pipeline for one pod bucket: vmapped
+        self-masking step driven by the shared ``run_batched_lanes``
+        scaffold, finalized per lane. The champion policy is a closure
+        constant; workload/ktable/state are traced ARGUMENTS."""
+        cfg = self.bucket_config(pod_bucket)
+        max_steps = cfg.max_steps
+        mod, pp, params = self._mod, self.param_policy, self.params
+
+        def step_one(w, k, s):
+            return mod.build_step(
+                w, lambda pod, nodes: pp(params, pod, nodes),
+                cfg, k, max_steps)(s)
+
+        vstep = jax.vmap(step_one, in_axes=(0, 0, 0))
+        vfin = jax.vmap(lambda w, s: mod.finalize(w, cfg, s),
+                        in_axes=(0, 0))
+
+        def serve_fn(wl, kt, state0):
+            final = run_batched_lanes(lambda s: vstep(wl, kt, s), state0,
+                                      max_steps, active_fn=mod.lane_active)
+            return vfin(wl, final)
+
+        return serve_fn
+
+    def _example_batch(self, lanes: int, pod_bucket: int):
+        """A minimal valid batch at the bucket's exact avals, for
+        ``lower()``: the smallest query routing can send here, replicated
+        across lanes by the same ``pad_population`` path real batches
+        use."""
+        pods = [{"cpu_milli": 1, "memory_mib": 1, "creation_time": t,
+                 "duration_time": 10}
+                for t in range(self.envelope.min_real_pods(pod_bucket))]
+        cfg = self.bucket_config(pod_bucket)
+        stacked = stack_queries(self._mod, self.cluster, [pods], pod_bucket,
+                                cfg, self._klen(pod_bucket))
+        padded, _ = pad_population(stacked, lanes)
+        return padded
+
+    def compiled_for(self, lanes: int, pod_bucket: int):
+        """The (lanes, pod_bucket) AOT executable, compiling on first use.
+        ``jax.jit(...).lower(...).compile()`` returns a ``Compiled``
+        object whose __call__ never compiles — argument avals either
+        match or raise."""
+        key = (lanes, pod_bucket)
+        hit = self._compiled.get(key)
+        if hit is not None:
+            return hit
+        with obs.span("serve_compile", lanes=lanes, pods=pod_bucket,
+                      engine=self.engine_name):
+            example = self._example_batch(lanes, pod_bucket)
+            compiled = jax.jit(
+                self._make_serve_fn(pod_bucket)).lower(*example).compile()
+        self._compiled[key] = compiled
+        self.cold_compiles += 1
+        return compiled
+
+    def warmup(self, lane_buckets: Optional[Sequence[int]] = None,
+               pod_buckets: Optional[Sequence[int]] = None) -> int:
+        """Eagerly compile every (lane, pod) bucket combination (or the
+        given subsets). Returns the number of executables now resident."""
+        for lb in lane_buckets or self.envelope.lane_buckets():
+            for pb in pod_buckets or self.envelope.pod_buckets():
+                self.compiled_for(lb, pb)
+        return len(self._compiled)
+
+    # ----- answering
+
+    def answer_batch(self, pod_lists: Sequence[Sequence[dict]]) -> List[dict]:
+        """Answer N "place this pod list" queries. Queries are grouped by
+        pod bucket, chunked at max_batch, lane-padded to the compiled
+        lane bucket (``pad_population`` — the request batcher), run
+        through the warm executable, and scattered back in input order."""
+        for pods in pod_lists:
+            validate_query_pods(pods, max_pods=self.envelope.max_pods,
+                                max_gpu_milli=self.envelope.max_gpu_milli)
+        answers: List[Optional[dict]] = [None] * len(pod_lists)
+        groups: Dict[int, List[int]] = {}
+        for i, pods in enumerate(pod_lists):
+            groups.setdefault(
+                self.envelope.pod_bucket_for(len(pods)), []).append(i)
+        mb = self.envelope.max_batch
+        for bucket, idxs in groups.items():
+            for c0 in range(0, len(idxs), mb):
+                self._run_chunk(bucket, idxs[c0:c0 + mb], pod_lists, answers)
+        return answers  # type: ignore[return-value]
+
+    def _run_chunk(self, bucket: int, idxs: List[int],
+                   pod_lists, answers) -> None:
+        lanes = self.envelope.lanes_for(len(idxs))
+        cfg = self.bucket_config(bucket)
+        stacked = stack_queries(self._mod, self.cluster,
+                                [pod_lists[i] for i in idxs], bucket, cfg,
+                                self._klen(bucket))
+        (wl, kt, s0), real = pad_population(stacked, lanes)
+        compiled = self.compiled_for(lanes, bucket)
+        with obs.span("serve_batch", lanes=lanes, bucket_pods=bucket,
+                      real=real) as t:
+            res = compiled(wl, kt, s0)
+            t.sync(res.policy_score)
+        res = jax.device_get(res)
+        for lane, i in enumerate(idxs):
+            answers[i] = self._extract(res, lane, len(pod_lists[i]),
+                                       bucket, lanes)
+
+    def _extract(self, res, lane: Optional[int], p_real: int,
+                 bucket: int, lanes: int) -> dict:
+        """One lane's SimResult slice -> an answer dict (``lane=None``
+        reads an unbatched scalar result). Placements cover REAL pods
+        only; node -1 means unplaced; GPU bitmask unpacked to indices."""
+        pick = (lambda x: np.asarray(x)) if lane is None else \
+            (lambda x: np.asarray(x)[lane])
+        assigned = pick(res.assigned_node)[:p_real]
+        gpus = pick(res.assigned_gpus)[:p_real].astype(np.int64)
+        node_ids = self.cluster.node_ids
+        placements = []
+        for i, (nd, gm) in enumerate(zip(assigned, gpus)):
+            row = {"pod": i, "node": int(nd),
+                   "gpus": [b for b in range(int(gm).bit_length())
+                            if int(gm) >> b & 1]}
+            if 0 <= int(nd) < len(node_ids):
+                row["node_id"] = node_ids[int(nd)]
+            placements.append(row)
+        return {
+            "score": float(pick(res.policy_score)),
+            "scheduled": int(pick(res.scheduled_pods)),
+            "failed": bool(pick(res.failed)),
+            "truncated": bool(pick(res.truncated)),
+            "events": int(pick(res.events_processed)),
+            "placements": placements,
+            "bucket_pods": bucket,
+            "bucket_lanes": lanes,
+        }
+
+    def reference_answer(self, pods: Sequence[dict]) -> dict:
+        """The UNBATCHED exact-engine answer for one query, at the same
+        bucket semantics (same padded workload, same SimConfig) — what
+        the ParitySentinel audits served answers against. Independent
+        code path on purpose: single-lane ``make_param_run_fn`` with its
+        own ``loop_tables`` sizing, no vmap, no lane padding."""
+        from fks_tpu.sim import engine as exact
+
+        validate_query_pods(pods, max_pods=self.envelope.max_pods,
+                            max_gpu_milli=self.envelope.max_gpu_milli)
+        bucket = self.envelope.pod_bucket_for(len(pods))
+        cfg = self.bucket_config(bucket)
+        wl = build_query_workload(self.cluster, pods, bucket)
+        run = jax.jit(exact.make_param_run_fn(wl, self.param_policy, cfg))
+        res = jax.device_get(run(self.params, exact.initial_state(wl, cfg)))
+        return self._extract(res, None, len(pods), bucket, 1)
+
+    # ----- persistence
+
+    def save(self, directory: str) -> str:
+        """Persist the engine spec (champion + cluster + envelope + knobs)
+        as ``artifact.json`` and point the JAX compilation cache at the
+        artifact's ``xla_cache/`` so compiled programs persist alongside.
+        ``warmup()`` first to bank every bucket's binary."""
+        os.makedirs(directory, exist_ok=True)
+        doc = {
+            "version": ARTIFACT_VERSION,
+            "champion": self.champion.to_json(),
+            "envelope": self.envelope.to_json(),
+            "engine": self.engine_name,
+            "prefilter_k": self.prefilter_k,
+            "state_pack": self.state_pack,
+            "max_steps_factor": self.max_steps_factor,
+            "policy_tier": self.policy_tier,
+            "cluster": _cluster_to_json(self.cluster),
+            "base_pods": self.base_pods,
+        }
+        path = os.path.join(directory, "artifact.json")
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f)
+        os.replace(tmp, path)  # atomic: a loader never sees a half-write
+        enable_persistent_cache(os.path.join(directory, "xla_cache"))
+        return path
+
+    @classmethod
+    def load(cls, directory: str, recorder=None) -> "ServeEngine":
+        """Rebuild a saved engine. Self-contained: the artifact pins the
+        cluster arrays and the resolved prefilter-k (no re-probe), and
+        re-attaches the artifact's compilation cache so ``compiled_for``
+        fetches banked binaries instead of re-running XLA."""
+        with open(os.path.join(directory, "artifact.json")) as f:
+            doc = json.load(f)
+        if doc.get("version") != ARTIFACT_VERSION:
+            raise ValueError(
+                f"artifact version {doc.get('version')} != "
+                f"{ARTIFACT_VERSION}")
+        cluster = _cluster_from_json(doc["cluster"])
+        wl = Workload(cluster=cluster,
+                      pods=_pods_from_dicts(doc.get("base_pods", [])))
+        eng = cls(ChampionSpec.from_json(doc["champion"]), wl,
+                  envelope=ShapeEnvelope.from_json(doc["envelope"]),
+                  engine=doc["engine"],
+                  prefilter_k=int(doc["prefilter_k"]),
+                  state_pack=bool(doc["state_pack"]),
+                  max_steps_factor=int(doc["max_steps_factor"]),
+                  recorder=recorder)
+        enable_persistent_cache(os.path.join(directory, "xla_cache"))
+        return eng
+
+
+def _pods_from_dicts(pods: List[dict]):
+    """Query-schema dicts -> a real-sized PodArrays (artifact base trace)."""
+    from fks_tpu.data.entities import PodArrays
+
+    p = max(1, len(pods))
+    col = lambda f, d=0: np.asarray(  # noqa: E731
+        [int(x.get(f, d)) for x in pods] + [0] * (p - len(pods)), np.int32)
+    from fks_tpu.serve.batcher import DEFAULT_DURATION
+    return PodArrays(
+        cpu=col("cpu_milli"), mem=col("memory_mib"),
+        num_gpu=col("num_gpu"), gpu_milli=col("gpu_milli"),
+        creation_time=col("creation_time"),
+        duration=col("duration_time", DEFAULT_DURATION),
+        tie_rank=np.arange(p, dtype=np.int32),
+        pod_mask=np.arange(p) < len(pods),
+        pod_ids=tuple(f"q-{i:05d}" for i in range(len(pods))),
+    )

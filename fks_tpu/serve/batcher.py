@@ -1,0 +1,243 @@
+"""Request coalescing onto the population axis.
+
+The serving insight (ISSUE 8; "Speeding up Policy Simulation in Supply
+Chain RL"): the engine already knows how to run many independent lanes in
+ONE compiled program — the population/trace-batch machinery
+(fks_tpu.parallel). A what-if query is just a one-trace lane, so N
+concurrent queries cost one vmapped call: build each query's padded
+workload, stack them exactly like ``parallel.traces.stack_traces`` does,
+pad the lane axis to the compiled lane bucket with
+``parallel.mesh.pad_population`` (the population padder IS the request
+batcher), run the AOT executable, and scatter each lane's answer back to
+its request.
+
+Three layers here, none of which import the artifact layer (so the
+dependency points artifact -> batcher):
+
+- query -> padded ``Workload`` construction (``build_query_workload``)
+  + leaf-wise stacking with sentinel-padded snapshot tables
+  (``stack_queries``), mirroring ``stack_traces`` at a FIXED bucket
+  shape so every same-bucket batch shares one treedef and one aval set;
+- ``RequestBatcher``: the flush-policy coalescer (max batch / max wait)
+  mapping concurrent ``submit()`` futures onto synchronous batch calls;
+- pod-array <-> dict conversion helpers shared by the CLI/service layer.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from concurrent.futures import Future
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fks_tpu.data.entities import PodArrays, Workload
+from fks_tpu.parallel.traces import strip_ids
+from fks_tpu.sim.evaluator import max_snapshot_count, snapshot_trigger_table
+
+#: query pod schema — the reference entity field names (simulator/
+#: entities.py:29-43), matching the LLM-facing template docstring
+POD_FIELDS = ("cpu_milli", "memory_mib", "num_gpu", "gpu_milli",
+              "creation_time", "duration_time")
+
+#: default lifetime for query pods that omit duration_time: effectively
+#: "never deleted inside the what-if horizon"
+DEFAULT_DURATION = 1_000_000
+
+
+def validate_query_pods(pods: Sequence[Dict[str, Any]], *, max_pods: int,
+                        max_gpu_milli: int) -> None:
+    """Reject malformed queries before any device work (the error message
+    is the service's 4xx body)."""
+    if not pods:
+        raise ValueError("query has no pods")
+    if len(pods) > max_pods:
+        raise ValueError(
+            f"query has {len(pods)} pods > envelope max_pods {max_pods}")
+    for i, p in enumerate(pods):
+        if not isinstance(p, dict):
+            raise ValueError(f"pod {i} is not an object")
+        gm = int(p.get("gpu_milli", 0))
+        if gm > max_gpu_milli:
+            raise ValueError(
+                f"pod {i} gpu_milli {gm} > envelope max_gpu_milli "
+                f"{max_gpu_milli}")
+        for field in ("cpu_milli", "memory_mib", "num_gpu", "gpu_milli"):
+            if int(p.get(field, 0)) < 0:
+                raise ValueError(f"pod {i} {field} is negative")
+
+
+def build_query_workload(cluster, pods: Sequence[Dict[str, Any]],
+                         bucket: int) -> Workload:
+    """One query -> a ``Workload`` padded to the pod bucket.
+
+    Pod ids are zero-padded ordinals, so the reference's lexicographic
+    tie order equals index order and ``tie_rank = arange`` reproduces it
+    exactly. Padding rows are zeros under a False pod_mask (the
+    ``pad_workload`` idiom — never read by the engine)."""
+    p_real = len(pods)
+    if p_real > bucket:
+        raise ValueError(f"{p_real} pods exceed pod bucket {bucket}")
+
+    def col(field: str, default: int = 0) -> np.ndarray:
+        a = np.zeros(bucket, np.int32)
+        for i, p in enumerate(pods):
+            a[i] = int(p.get(field, default))
+        return a
+
+    pa = PodArrays(
+        cpu=col("cpu_milli"),
+        mem=col("memory_mib"),
+        num_gpu=col("num_gpu"),
+        gpu_milli=col("gpu_milli"),
+        creation_time=col("creation_time"),
+        duration=col("duration_time", DEFAULT_DURATION),
+        tie_rank=np.arange(bucket, dtype=np.int32),
+        pod_mask=np.arange(bucket) < p_real,
+        pod_ids=tuple(f"q-{i:05d}" for i in range(p_real)),
+    )
+    return Workload(cluster=cluster, pods=pa, faults=None)
+
+
+def stack_queries(mod, cluster, pod_lists: Sequence[Sequence[dict]],
+                  bucket: int, cfg, klen: int):
+    """Stack Q query workloads into (workload[Q,...], ktable[Q,K],
+    state0[Q,...]) at the bucket's fixed shapes.
+
+    The ``stack_traces`` recipe with serving's extra constraint: K
+    (``klen``) is fixed per bucket so every batch matches the AOT
+    executable's avals. Each query's snapshot table is sized from its
+    REAL pod count (the reference's ``initialize(total_events)``
+    semantics) and padded with the INT32_MAX sentinel, which never fires.
+    ``cfg.max_steps`` must be the bucket's resolved step budget."""
+    max_steps = cfg.max_steps
+    assert max_steps is not None, "bucket SimConfig must pin max_steps"
+    wls = [build_query_workload(cluster, p, bucket) for p in pod_lists]
+    sentinel = np.iinfo(np.int32).max
+    kt = np.full((len(wls), klen), sentinel, np.int32)
+    for i, w in enumerate(wls):
+        tbl = snapshot_trigger_table(
+            w.num_pods,
+            max_snapshot_count(max_steps, w.num_pods, cfg.snapshot_interval),
+            cfg.snapshot_interval)
+        if len(tbl) > klen:
+            raise ValueError(
+                f"query with {w.num_pods} pods needs {len(tbl)} snapshot "
+                f"slots > bucket table width {klen}; route it to a smaller "
+                "bucket")
+        kt[i, : len(tbl)] = tbl
+    states = [mod.initial_state(w, cfg) for w in wls]
+    stacked_wl = jax.tree_util.tree_map(
+        lambda *xs: jnp.stack([jnp.asarray(x) for x in xs]),
+        *[strip_ids(w) for w in wls])
+    stacked_state = jax.tree_util.tree_map(
+        lambda *xs: jnp.stack([jnp.asarray(x) for x in xs]), *states)
+    return stacked_wl, jnp.asarray(kt), stacked_state
+
+
+def pods_to_dicts(pods: PodArrays, limit: Optional[int] = None) -> List[dict]:
+    """Real pod rows back to query-schema dicts — sources selftest and
+    trace-replay queries from a parsed workload."""
+    mask = np.asarray(pods.pod_mask)
+    idx = np.nonzero(mask)[0]
+    if limit is not None:
+        idx = idx[:limit]
+    cols = {
+        "cpu_milli": np.asarray(pods.cpu),
+        "memory_mib": np.asarray(pods.mem),
+        "num_gpu": np.asarray(pods.num_gpu),
+        "gpu_milli": np.asarray(pods.gpu_milli),
+        "creation_time": np.asarray(pods.creation_time),
+        "duration_time": np.asarray(pods.duration),
+    }
+    return [{k: int(v[i]) for k, v in cols.items()} for i in idx]
+
+
+class RequestBatcher:
+    """Flush-policy request coalescer over a synchronous batch handler.
+
+    ``submit(query)`` returns a Future; a daemon thread accumulates
+    pending requests and flushes a batch when it reaches ``max_batch``
+    OR the oldest pending request has waited ``max_wait_s`` — the
+    classic latency/occupancy trade. The handler receives
+    ``(queries, enqueue_times)`` and returns one answer per query in
+    order (scatter-back is positional); a handler exception fails every
+    future in the batch. ``close()`` flushes the remainder and joins."""
+
+    def __init__(self, handle_batch: Callable[[list, list], list],
+                 max_batch: int = 8, max_wait_s: float = 0.005):
+        if max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+        self._handle = handle_batch
+        self.max_batch = int(max_batch)
+        self.max_wait_s = float(max_wait_s)
+        self._q: "queue.SimpleQueue" = queue.SimpleQueue()
+        self.batches = 0
+        self.submitted = 0
+        self._occupancy_sum = 0.0
+        self._closed = False
+        self._thread = threading.Thread(
+            target=self._loop, name="serve-batcher", daemon=True)
+        self._thread.start()
+
+    def submit(self, query) -> Future:
+        if self._closed:
+            raise RuntimeError("batcher is closed")
+        self.submitted += 1
+        fut: Future = Future()
+        self._q.put((query, fut, time.perf_counter()))
+        return fut
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self._q.put(None)
+        self._thread.join()
+
+    @property
+    def mean_occupancy(self) -> float:
+        """Mean fraction of max_batch filled per flushed batch."""
+        return self._occupancy_sum / self.batches if self.batches else 0.0
+
+    # ----- internals
+
+    def _loop(self) -> None:
+        pending: list = []
+        while True:
+            timeout = None
+            if pending:
+                waited = time.perf_counter() - pending[0][2]
+                timeout = max(0.0, self.max_wait_s - waited)
+            try:
+                item = self._q.get(timeout=timeout)
+            except queue.Empty:  # oldest request hit max_wait
+                self._flush(pending)
+                pending = []
+                continue
+            if item is None:  # close sentinel
+                self._flush(pending)
+                return
+            pending.append(item)
+            if len(pending) >= self.max_batch:
+                self._flush(pending)
+                pending = []
+
+    def _flush(self, pending: list) -> None:
+        if not pending:
+            return
+        self.batches += 1
+        self._occupancy_sum += len(pending) / self.max_batch
+        queries = [q for q, _, _ in pending]
+        enq = [t for _, _, t in pending]
+        try:
+            answers = self._handle(queries, enq)
+        except Exception as e:  # noqa: BLE001 — fail the batch, not the loop
+            for _, fut, _ in pending:
+                fut.set_exception(e)
+            return
+        for (_, fut, _), ans in zip(pending, answers):
+            fut.set_result(ans)

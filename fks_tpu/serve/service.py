@@ -1,0 +1,292 @@
+"""Serving front: requests in, audited answers + latency metrics out.
+
+``ServeService`` wraps a ``ServeEngine`` with the request-side concerns
+the engine itself stays free of: query resolution ("place this pod list"
+vs "replay this what-if trace"), the ``RequestBatcher`` coalescer, the
+per-request ``serve_request`` metric (latency, batch occupancy, bucket
+shape) through the FlightRecorder/OpenMetrics stack, and the every-Nth
+``ParitySentinel`` audit of served answers against the unbatched exact
+engine. Two fronts ride on it: stdin/JSONL (``run_jsonl``) and a
+localhost-only HTTP listener (``run_http``); both are thin — the service
+is the library entrypoint.
+
+``selftest`` is the batched-vs-unbatched parity sweep the
+``run_full_suite`` serve gate (and ``cli serve --selftest``) runs.
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from fks_tpu import obs
+from fks_tpu.obs.watchdog import ParitySentinel
+from fks_tpu.serve.artifact import ServeEngine
+from fks_tpu.serve.batcher import RequestBatcher, pods_to_dicts
+
+
+class ServeService:
+    """The request/metrics layer over a warm ``ServeEngine``.
+
+    ``submit(query)`` resolves the query to a pod list (failing fast on
+    malformed input, before it can poison a batch), hands it to the
+    coalescer, and returns a Future of the answer dict. ``audit_every=N``
+    routes every Nth request back through ``engine.reference_answer`` and
+    the ParitySentinel — a served answer that drifts from the exact
+    engine raises an alert event, not just a log line."""
+
+    def __init__(self, engine: ServeEngine, *, recorder=None,
+                 max_batch: Optional[int] = None, max_wait_s: float = 0.005,
+                 audit_every: int = 0, audit_tol: float = 1e-5):
+        self.engine = engine
+        self.recorder = recorder if recorder is not None else obs.get_recorder()
+        self.audit_every = int(audit_every)
+        self.sentinel = ParitySentinel(None, tol=audit_tol,
+                                       recorder=self.recorder)
+        self._batcher = RequestBatcher(
+            self._handle_batch,
+            max_batch=max_batch or engine.envelope.max_batch,
+            max_wait_s=max_wait_s)
+        self._seq = 0
+        self._latencies_ms: List[float] = []
+        self._t_first: Optional[float] = None
+        self._t_last: float = 0.0
+        self.audits = 0
+        self.audit_failures = 0
+
+    # ----- query resolution
+
+    def resolve_query(self, query: Dict[str, Any]) -> Tuple[str, List[dict]]:
+        """A request JSON -> (request_id, pod list).
+
+        ``{"pods": [...]}`` places an explicit pod list; ``{"trace": path,
+        "limit": N}`` replays a what-if trace — its first N pods (default:
+        whatever fits the envelope) against the PINNED cluster, which is
+        the serving question ("what would the champion do with this
+        arrival stream here"), not a re-evaluation on the trace's own
+        cluster."""
+        if not isinstance(query, dict):
+            raise ValueError("query must be a JSON object")
+        rid = str(query.get("id", ""))
+        if not rid:
+            self._seq += 1
+            rid = f"r{self._seq:06d}"
+        if "pods" in query:
+            pods = query["pods"]
+        elif "trace" in query:
+            from fks_tpu.data.traces import TraceParser
+
+            wl = TraceParser().parse_workload(pod_file=query["trace"])
+            limit = int(query.get("limit", self.engine.envelope.max_pods))
+            pods = pods_to_dicts(wl.pods, limit=limit)
+        else:
+            raise ValueError("query needs 'pods' (pod list) or 'trace' "
+                             "(what-if trace to replay)")
+        return rid, pods
+
+    def submit(self, query: Dict[str, Any]):
+        """Resolve + enqueue; returns a Future resolving to the answer
+        dict (with ``id`` and ``latency_ms`` attached)."""
+        rid, pods = self.resolve_query(query)
+        return self._batcher.submit((rid, pods))
+
+    def close(self) -> None:
+        self._batcher.close()
+
+    # ----- batch handling (batcher thread)
+
+    def _handle_batch(self, items: List[Tuple[str, List[dict]]],
+                      enq_times: List[float]) -> List[dict]:
+        answers = self.engine.answer_batch([pods for _, pods in items])
+        done = time.perf_counter()
+        if self._t_first is None:
+            self._t_first = min(enq_times)
+        self._t_last = done
+        occupancy = len(items) / self._batcher.max_batch
+        for (rid, pods), enq, ans in zip(items, enq_times, answers):
+            latency_ms = (done - enq) * 1e3
+            ans["id"] = rid
+            ans["latency_ms"] = round(latency_ms, 3)
+            self._latencies_ms.append(latency_ms)
+            self.recorder.metric(
+                "serve_request", request_id=rid,
+                latency_ms=round(latency_ms, 3), batch_size=len(items),
+                batch_occupancy=round(occupancy, 4),
+                bucket_pods=ans["bucket_pods"],
+                bucket_lanes=ans["bucket_lanes"])
+            if self.audit_every > 0 and \
+                    len(self._latencies_ms) % self.audit_every == 0:
+                self._audit(rid, pods, ans)
+        return answers
+
+    def _audit(self, rid: str, pods: List[dict], ans: dict) -> None:
+        ref = self.engine.reference_answer(pods)
+        ok = self.sentinel.audit_served(
+            rid, ans["score"], ref["score"],
+            placements_match=ans["placements"] == ref["placements"])
+        self.audits += 1
+        if not ok:
+            self.audit_failures += 1
+
+    # ----- stats
+
+    def summary(self, record: bool = True) -> dict:
+        lat = np.asarray(self._latencies_ms, np.float64)
+        elapsed = (self._t_last - self._t_first) \
+            if self._t_first is not None else 0.0
+        out = {
+            "requests": len(lat),
+            "batches": self._batcher.batches,
+            "mean_occupancy": round(self._batcher.mean_occupancy, 4),
+            "p50_ms": round(float(np.percentile(lat, 50)), 3) if len(lat)
+            else 0.0,
+            "p99_ms": round(float(np.percentile(lat, 99)), 3) if len(lat)
+            else 0.0,
+            "qps": round(len(lat) / elapsed, 2) if elapsed > 0 else 0.0,
+            "cold_compiles": self.engine.cold_compiles,
+            "audits": self.audits,
+            "audit_failures": self.audit_failures,
+        }
+        if record:
+            self.recorder.metric("serve", **out)
+        return out
+
+
+# ------------------------------------------------------------------ fronts
+
+
+def run_jsonl(service: ServeService, stream_in=None, stream_out=None) -> int:
+    """JSONL front: one request object per input line, one answer object
+    per output line, INPUT ORDER preserved (answers are scattered back to
+    their line even when batching reorders completion). A malformed line
+    answers ``{"id", "error"}`` instead of killing the stream. Returns
+    the number of failed requests."""
+    stream_in = stream_in if stream_in is not None else sys.stdin
+    stream_out = stream_out if stream_out is not None else sys.stdout
+    results: List[Tuple[str, Any]] = []  # (rid, Future | error dict)
+    errors = 0
+    for lineno, line in enumerate(stream_in, 1):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            query = json.loads(line)
+            results.append(("", service.submit(query)))
+        except Exception as e:  # noqa: BLE001 — per-line 4xx semantics
+            errors += 1
+            results.append(("", {"id": f"line{lineno}", "error": str(e)}))
+    service.close()  # flush the tail batch before draining futures
+    for _, res in results:
+        if isinstance(res, dict):
+            ans = res
+        else:
+            try:
+                ans = res.result()
+            except Exception as e:  # noqa: BLE001
+                errors += 1
+                ans = {"error": str(e)}
+        print(json.dumps(ans), file=stream_out)
+    return errors
+
+
+def run_http(service: ServeService, port: int, *, host: str = "127.0.0.1",
+             max_requests: Optional[int] = None) -> None:
+    """Localhost-only HTTP front: POST /query (request JSON -> answer
+    JSON), GET /stats (service summary), GET /healthz. ``max_requests``
+    stops the listener after N queries (test hook); otherwise blocks
+    until interrupted."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    served = {"n": 0}
+
+    class Handler(BaseHTTPRequestHandler):
+        def _send(self, code: int, doc: dict) -> None:
+            body = json.dumps(doc).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):  # noqa: N802 — http.server API
+            if self.path == "/healthz":
+                self._send(200, {"ok": True})
+            elif self.path == "/stats":
+                self._send(200, service.summary(record=False))
+            else:
+                self._send(404, {"error": f"no route {self.path}"})
+
+        def do_POST(self):  # noqa: N802 — http.server API
+            if self.path != "/query":
+                self._send(404, {"error": f"no route {self.path}"})
+                return
+            try:
+                n = int(self.headers.get("Content-Length", 0))
+                query = json.loads(self.rfile.read(n))
+                ans = service.submit(query).result(timeout=60.0)
+                self._send(200, ans)
+            except ValueError as e:
+                self._send(400, {"error": str(e)})
+            except Exception as e:  # noqa: BLE001 — surface, don't crash
+                self._send(500, {"error": str(e)})
+            served["n"] += 1
+            if max_requests is not None and served["n"] >= max_requests:
+                import threading
+                threading.Thread(target=server.shutdown, daemon=True).start()
+
+        def log_message(self, *a):  # quiet: the recorder is the log
+            pass
+
+    server = ThreadingHTTPServer((host, port), Handler)
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.server_close()
+
+
+# ----------------------------------------------------------------- selftest
+
+
+def selftest(engine: ServeEngine, count: int = 8, pods_per_query: int = 4,
+             tol: float = 1e-5) -> dict:
+    """Batched-vs-unbatched parity sweep: ``count`` queries sliced from
+    the pinned workload's real pods (sliding windows, so queries differ),
+    answered through the batched warm path and re-answered one-by-one by
+    the unbatched exact engine. The serve gate's contract: every score
+    within ``tol``, every placement list identical."""
+    base = engine.base_pods
+    if not base:  # artifact pinned with an empty trace — synthesize
+        base = [{"cpu_milli": 1 + i, "memory_mib": 1, "creation_time": i,
+                 "duration_time": 100} for i in range(pods_per_query * 2)]
+    queries = []
+    for i in range(count):
+        start = i % max(1, len(base) - pods_per_query + 1)
+        q = base[start:start + pods_per_query]
+        queries.append(q if q else base[:1])
+    batched = engine.answer_batch(queries)
+    max_drift = 0.0
+    placements_ok = True
+    failures = []
+    for i, (q, ans) in enumerate(zip(queries, batched)):
+        ref = engine.reference_answer(q)
+        drift = abs(ans["score"] - ref["score"])
+        max_drift = max(max_drift, drift)
+        same = ans["placements"] == ref["placements"]
+        placements_ok = placements_ok and same
+        if drift > tol or not same:
+            failures.append({"query": i, "drift": round(drift, 8),
+                             "placements_match": same})
+    return {
+        "ok": not failures,
+        "checked": len(queries),
+        "max_drift": round(max_drift, 10),
+        "placements_match": placements_ok,
+        "tol": tol,
+        "engine": engine.engine_name,
+        "failures": failures[:5],
+    }

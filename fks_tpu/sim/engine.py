@@ -807,3 +807,93 @@ def simulate(workload: Workload, policy: PolicyFn,
     if jit:
         run = jax.jit(run)
     return run(initial_state(workload, cfg))
+
+
+# ------------------------------------------------- prefilter auto-enable
+#
+# PR 7's measurement (PROFILE.md round 11): top-k node prefiltering pays
+# 13-16x when the per-node policy is expensive (the VM code-candidate
+# tier) and LOSES (~0.6x) when it is cheap (parametric dot products)
+# because the step is then queue-dominated and the candidate gather is
+# pure overhead. The break-even is a property of the policy's
+# per-invocation cost, not of any static code attribute — so the
+# auto-enable heuristic keys on a measured probe.
+
+#: k chosen when the heuristic enables prefiltering (the PROFILE round-11
+#: sweep's winning setting at 1k nodes)
+PREFILTER_AUTO_K = 64
+#: policy cost above which prefiltering wins. The round-11 data points on
+#: flat CPU: parametric ~2e-5 s/invocation (prefilter loses), VM code
+#: candidates ~1e-3 s (prefilter wins 13-16x); the threshold sits an
+#: order of magnitude clear of both.
+PREFILTER_COST_THRESHOLD_S = 2e-4
+#: below this node count the dense sweep is cheap regardless of policy
+#: cost and the gather bookkeeping cannot win it back
+PREFILTER_MIN_NODES = 256
+
+
+def probe_policy_cost(param_policy, params, n_padded: int, g_padded: int,
+                      reps: int = 5) -> float:
+    """Steady-state wall seconds of ONE policy invocation at the padded
+    cluster shape: jit the bare policy on all-ones dummy views, discard
+    the compile call, return the min over ``reps`` timed calls. Host-side
+    and backend-agnostic; the one-time compile is the probe's only real
+    cost (the timed calls are microseconds)."""
+    import time as _time
+
+    i = jnp.zeros((), jnp.int32)
+    vn = jnp.ones(n_padded, jnp.int32)
+    vg = jnp.ones((n_padded, g_padded), jnp.int32)
+    pod = PodView(i, i, i, i, i, i)
+    nodes = NodeView(vn, vn, vn, vn, vn, vn, vg, vg, vg,
+                     jnp.ones((n_padded, g_padded), bool),
+                     jnp.ones(n_padded, bool))
+    fn = jax.jit(lambda p: param_policy(p, pod, nodes))
+    jax.block_until_ready(fn(params))  # compile, excluded from timing
+    best = float("inf")
+    for _ in range(max(1, reps)):
+        t0 = _time.perf_counter()
+        jax.block_until_ready(fn(params))
+        best = min(best, _time.perf_counter() - t0)
+    return best
+
+
+def auto_prefilter_k(n_padded: int, policy_cost_s: Optional[float], *,
+                     override: Optional[int] = None,
+                     k: int = PREFILTER_AUTO_K,
+                     threshold_s: float = PREFILTER_COST_THRESHOLD_S,
+                     min_nodes: int = PREFILTER_MIN_NODES) -> int:
+    """Pick ``SimConfig.node_prefilter_k`` from a measured policy cost.
+
+    Pure decision function (timing-free, unit-testable): an explicit
+    ``override`` always wins; otherwise prefiltering turns on iff the
+    node axis is large enough (``min_nodes``) AND one policy invocation
+    costs more than ``threshold_s``. ``policy_cost_s`` of None reads as
+    "unknown" and keeps the conservative dense sweep."""
+    if override is not None:
+        return int(override)
+    if n_padded < min_nodes:
+        return 0
+    if policy_cost_s is None or policy_cost_s <= threshold_s:
+        return 0
+    return k
+
+
+def resolve_auto_prefilter(param_policy, params, n_padded: int,
+                           g_padded: int, *, override: Optional[int] = None,
+                           recorder=None, **heuristic_kw) -> int:
+    """``auto_prefilter_k`` with the timing probe run only when its answer
+    can matter: an explicit override or a small node axis skips the
+    (compile-costing) probe entirely. Records a ``prefilter_auto`` event
+    on the given recorder so run dirs show why k was chosen."""
+    if override is not None:
+        return int(override)
+    min_nodes = heuristic_kw.get("min_nodes", PREFILTER_MIN_NODES)
+    if n_padded < min_nodes:
+        return 0
+    cost = probe_policy_cost(param_policy, params, n_padded, g_padded)
+    chosen = auto_prefilter_k(n_padded, cost, **heuristic_kw)
+    if recorder is not None:
+        recorder.event("prefilter_auto", policy_cost_s=round(cost, 7),
+                       chosen_k=chosen, n_padded=n_padded)
+    return chosen

@@ -84,6 +84,11 @@ METRIC_KIND_REQUIRED: Dict[str, Tuple[str, ...]] = {
     # scale knobs (prefilter / packed dtypes) produced the number
     "scale_tier": ("nodes", "pods", "events_per_sec",
                    "node_prefilter_k", "state_pack"),
+    # champion serving (fks_tpu.serve): one record per served request —
+    # what it cost (latency), how well the coalescer packed the batch
+    # (occupancy), and which compiled shape bucket answered it
+    "serve_request": ("request_id", "latency_ms", "batch_size",
+                      "batch_occupancy", "bucket_pods", "bucket_lanes"),
 }
 
 #: an OpenMetrics sample line: name, optional {labels}, value, optional ts

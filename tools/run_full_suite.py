@@ -22,6 +22,12 @@ must complete and exit 0 — the cheap end-to-end check that the
 large-cluster path stays wired before the slow-marked 1k-node smoke
 test (tests/test_scale_tier.py) pays for the real shape. Recorded as
 ``scale_gate``.
+
+A SERVE GATE follows: ``cli serve --selftest`` — batched warm-path
+answers for queries sliced from the golden trace must match the
+unbatched exact engine (score drift <= 1e-5, placements identical,
+exit 0). A drift here means the serving tier's lane stacking or
+scatter-back is corrupting answers. Recorded as ``serve_gate``.
 """
 from __future__ import annotations
 
@@ -88,6 +94,23 @@ def scale_gate() -> dict:
     return {"ok": ok, **detail}
 
 
+def serve_gate() -> dict:
+    """Serving parity: the champion-serving selftest (batched warm-path
+    answers vs the unbatched exact engine, golden-trace queries) must
+    exit 0. Returns {"ok": bool, ...}."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "-m", "fks_tpu.cli", "serve", "--cpu",
+         "--selftest", "4", "--pods-per-query", "3",
+         "--max-pods", "16", "--max-batch", "4"],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=600)
+    ok = proc.returncode == 0
+    detail = {"rc": proc.returncode}
+    if not ok:
+        detail["err"] = (proc.stderr or proc.stdout or "")[-500:]
+    return {"ok": ok, **detail}
+
+
 def main() -> int:
     rev = subprocess.run(["git", "rev-parse", "--short", "HEAD"],
                          capture_output=True, text=True, cwd=REPO
@@ -101,6 +124,9 @@ def main() -> int:
     sgate = scale_gate()
     if not sgate["ok"]:
         print(f"SCALE GATE FAILED: {sgate}", file=sys.stderr)
+    vgate = serve_gate()
+    if not vgate["ok"]:
+        print(f"SERVE GATE FAILED: {vgate}", file=sys.stderr)
     t0 = time.time()
     proc = subprocess.run(
         [sys.executable, "-m", "pytest", "tests/", "-q",
@@ -111,11 +137,12 @@ def main() -> int:
     summary = tail[0] if tail else ""
     counts = {k: int(v) for v, k in re.findall(
         r"(\d+) (passed|failed|error|skipped|deselected|xfailed)", summary)}
-    gates_ok = gate["ok"] and tgate["ok"] and sgate["ok"]
+    gates_ok = gate["ok"] and tgate["ok"] and sgate["ok"] and vgate["ok"]
     rc = proc.returncode if gates_ok else (proc.returncode or 1)
     row = {"ts": round(time.time(), 1), "rev": rev, "rc": rc,
            "wall_s": wall, **counts, "obs_gate": gate,
-           "trace_gate": tgate, "scale_gate": sgate, "summary": summary}
+           "trace_gate": tgate, "scale_gate": sgate, "serve_gate": vgate,
+           "summary": summary}
     os.makedirs(os.path.dirname(OUT), exist_ok=True)
     with open(OUT, "a") as f:
         f.write(json.dumps(row) + "\n")
