@@ -784,6 +784,101 @@ def stage_budget(gate: str = "") -> int:
     return rc
 
 
+def stage_preflight(gate: str = "") -> int:
+    """CPU subprocess: static pre-flight headline — one FakeLLM candidate
+    stream (grammar + junk at ``FKS_BENCH_PREFLIGHT_JUNK``) evaluated
+    twice through CodeEvaluator (flat engine, batched VM tier): once with
+    the fks_tpu.analysis pre-flight + fingerprint dedup OFF (every
+    candidate pays sandbox/transpile/eval) and once ON (static rejects
+    and AST-fingerprint duplicates never reach the pipeline). Prints one
+    JSON line with ``preflight_reject_rate`` (statically rejected before
+    sandbox, over the whole stream), ``fingerprint_dup_rate``, the
+    steady-state wall delta, and a best-score parity audit (the analyzer
+    must never change WHO wins, only what the batch costs)."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    from fks_tpu.data.synthetic import synthetic_workload
+    from fks_tpu.funsearch import llm as llm_mod
+    from fks_tpu.funsearch import template
+    from fks_tpu.funsearch.backend import CodeEvaluator
+    from fks_tpu.obs import CompileWatcher
+    from fks_tpu.sim.engine import SimConfig
+
+    global _RECORDER
+    _RECORDER = _controller_recorder()
+    watcher = CompileWatcher().install()
+    pop = int(os.environ.get("FKS_BENCH_PREFLIGHT_POP", "64"))
+    junk = float(os.environ.get("FKS_BENCH_PREFLIGHT_JUNK", "0.3"))
+    wl = synthetic_workload(8, 200, seed=3)
+    cfg = SimConfig(max_steps=4 * wl.num_pods, track_ctime=False)
+    gen = llm_mod.FakeLLM(seed=7, junk_rate=junk)
+    codes = [template.fill_template(gen.complete("")) for _ in range(pop)]
+    log(f"preflight stage: pop={pop} junk_rate={junk}")
+
+    off = CodeEvaluator(wl, cfg, engine="flat", vm_batch=True,
+                        preflight=False, fp_dedup=False)
+    on = CodeEvaluator(wl, cfg, engine="flat", vm_batch=True)
+
+    # warm both paths: XLA compiles land here, not in the timed passes
+    t0 = time.perf_counter()
+    off.evaluate(codes)
+    on.evaluate(codes)
+    log(f"warm-up (compile+run, both paths): "
+        f"{time.perf_counter() - t0:.1f}s; XLA backend compile "
+        f"{watcher.backend_compile_seconds:.1f}s")
+    compiles_warm = watcher.backend_compile_count
+
+    t0 = time.perf_counter()
+    res_off = off.evaluate(codes)
+    off_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    res_on = on.evaluate(codes)
+    on_s = time.perf_counter() - t0
+    recompiles = watcher.backend_compile_count - compiles_warm
+
+    stats = on.last_eval_stats
+    rejected = stats.get("preflight_rejected", 0)
+    dupes = stats.get("fingerprint_duplicates", 0)
+    # parity audit: the analyzer only skips losers, so the best score of
+    # the stream must be bit-identical on both paths
+    best_off = float(np.max([r.score for r in res_off]))
+    best_on = float(np.max([r.score for r in res_on]))
+    log(f"steady-state: off {off_s:.3f}s vs on {on_s:.3f}s "
+        f"({rejected}/{pop} rejected pre-sandbox, {dupes} fp-dupes); "
+        f"best score off {best_off:.6f} on {best_on:.6f}")
+
+    payload = {
+        "preflight_reject_rate": round(rejected / pop, 4),
+        "fingerprint_dup_rate": round(dupes / pop, 4),
+        "preflight_speedup": round(off_s / on_s, 3) if on_s else 0.0,
+        "wall_seconds_off": round(off_s, 4),
+        "wall_seconds_on": round(on_s, 4),
+        "best_score_match": float(abs(best_off - best_on) <= 1e-9),
+        "population": pop,
+        "junk_rate": junk,
+        "unique_evaluated": stats.get("unique", 0),
+        "mean_static_work": stats.get("mean_static_work", 0),
+        "steady_state_recompiles": recompiles,
+        "backend_compiles": watcher.backend_compile_count,
+        "compile_seconds": round(watcher.backend_compile_seconds, 3),
+    }
+    _record("metric", "bench_stage", payload, stage="preflight",
+            platform="cpu")
+    rc = 0
+    if gate:
+        rc = _gate(gate, payload)
+    if payload["best_score_match"] != 1.0:
+        log("PREFLIGHT PARITY FAIL: analyzer changed the stream's best "
+            "score")
+        rc = rc or 1
+    _record("finish", "ok" if rc == 0 else "fail")
+    _record("close")
+    print(json.dumps(payload))
+    return rc
+
+
 def stage_scale1k(gate: str = "") -> int:
     """CPU subprocess: large-cluster scale-tier headline — a 1k-node x
     100k-pod synthetic workload (data.synthetic, OpenB-shaped) run to
@@ -1185,6 +1280,10 @@ def main():
         # --gate itself (it prints its own JSON line, not the
         # controller's)
         return stage_budget(gate)
+    if stage == "preflight":
+        # standalone static-analysis headline (pre-sandbox reject rate,
+        # fingerprint dedup, wall delta); same --gate contract as budget
+        return stage_preflight(gate)
     if stage == "scale1k":
         # standalone large-cluster scale-tier headline (1k nodes x 100k
         # pods, flat CPU); same self-contained --gate contract as budget

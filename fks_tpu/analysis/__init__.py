@@ -1,0 +1,15 @@
+"""Static analysis: candidate pre-flight (pillar A) + repo linter (pillar B).
+
+``candidate`` is imported eagerly (pure stdlib + the funsearch tables);
+``lint`` is NOT — it lowers jitted entry points and therefore pulls in
+jax, which callers on the evolve hot path never need.
+"""
+from fks_tpu.analysis.candidate import (
+    REJECT_TAXONOMY, CostEstimate, PreflightReport, fingerprint,
+    preflight_check,
+)
+
+__all__ = [
+    "REJECT_TAXONOMY", "CostEstimate", "PreflightReport", "fingerprint",
+    "preflight_check",
+]

@@ -20,6 +20,7 @@ Usage:
     python -m fks_tpu.cli compare BASELINE CANDIDATE [--threshold m=rel:X]
     python -m fks_tpu.cli trace-diff --engines exact,flat [--policy P | --code F]
     python -m fks_tpu.cli scenarios [--suite NAME [--scenario I]]
+    python -m fks_tpu.cli lint [PATHS...] [--write-pins | --no-pins]
     python -m fks_tpu.cli traces
 
 Every subcommand accepts ``--run-dir DIR`` to flight-record the run
@@ -780,6 +781,48 @@ def cmd_trace_diff(args):
     return 1 if record["divergent"] else 0
 
 
+def cmd_lint(args):
+    """Repo-wide JAX-invariant lint + jaxpr-pin gate (fks_tpu.analysis.
+    lint): AST checks for trace-safety violations over the given paths,
+    then the pinned-jaxpr manifest check (key entry points lowered with
+    each Python-static SimConfig flag and hashed). Exit code contract:
+    0 = clean, 1 = findings or pin drift, 2 = error — scriptable like
+    ``compare`` (tools/run_full_suite.py's lint gate leans on it).
+    ``--write-pins`` re-lowers and rewrites the manifest instead of
+    checking it (exit 0)."""
+    _apply_platform_flags(args)
+    from fks_tpu.analysis import lint
+
+    paths = args.paths or ["fks_tpu"]
+    pins_path = args.pins or lint.PIN_MANIFEST
+    findings = lint.lint_paths(paths)
+    for f in findings:
+        print(f)
+    pin_msgs = []
+    try:
+        if args.write_pins:
+            man = lint.write_pins(pins_path)
+            print(f"wrote {len(man['pins'])} jaxpr pins -> {pins_path}")
+        elif not args.no_pins:
+            pin_msgs = lint.check_pins(pins_path)
+            for m in pin_msgs:
+                print(m)
+    except Exception as e:  # noqa: BLE001 — broken lowering is an error,
+        print(f"error: {type(e).__name__}: {e}", file=sys.stderr)  # not drift
+        return 2
+    ok = not findings and not pin_msgs
+    with _flight_recorder(args, "lint") as rec:
+        rec.metric("lint_report", {
+            "paths": list(paths),
+            "findings": [f.to_json() for f in findings],
+            "pin_drift": list(pin_msgs),
+            "ok": ok,
+        })
+    print(f"lint: {len(findings)} finding(s), {len(pin_msgs)} pin "
+          f"message(s) -> {'clean' if ok else 'FAIL'}")
+    return 0 if ok else 1
+
+
 def cmd_scenarios(args):
     """Scenario-suite discovery and inspection (fks_tpu.scenarios): with no
     flags, list the registered suites; with ``--suite`` materialize one
@@ -1099,6 +1142,27 @@ def main(argv=None) -> int:
                     help="describe one scenario (0-based index) incl. its "
                          "fault timeline")
     sn.set_defaults(fn=cmd_scenarios)
+
+    ln = sub.add_parser(
+        "lint",
+        help="JAX-invariant AST lints + jaxpr-pin drift gate "
+             "(exit 1 on findings or drift)")
+    ln.add_argument("paths", nargs="*",
+                    help="files/directories to lint (default: fks_tpu)")
+    ln.add_argument("--pins", default="",
+                    help="pin manifest path (default: "
+                         "tests/fixtures/jaxpr_pins.json)")
+    ln.add_argument("--write-pins", action="store_true",
+                    help="recompute and rewrite the pin manifest instead "
+                         "of checking it")
+    ln.add_argument("--no-pins", action="store_true",
+                    help="AST lints only (skip the jaxpr lowering sweep)")
+    ln.add_argument("--cpu", action="store_true",
+                    help="force the CPU backend (skip the TPU tunnel)")
+    ln.add_argument("--run-dir", default="",
+                    help="flight-recorder run directory for the "
+                         "lint_report record")
+    ln.set_defaults(fn=cmd_lint)
 
     t = sub.add_parser("traces", help="list available trace files")
     t.set_defaults(fn=cmd_traces)

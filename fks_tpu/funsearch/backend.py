@@ -42,6 +42,7 @@ import numpy as np
 
 import dataclasses as _dc
 
+from fks_tpu import obs
 from fks_tpu.data.entities import Workload
 from fks_tpu.funsearch import transpiler, vm
 from fks_tpu.sim.engine import SimConfig
@@ -86,7 +87,8 @@ class CodeEvaluator:
     def __init__(self, workload: Workload, cfg: SimConfig = SimConfig(),
                  max_workers: Optional[int] = None, use_vm: bool = True,
                  engine: str = "exact", vm_batch: Optional[bool] = None,
-                 mesh=None, suite=None, robust=None, budget=None):
+                 mesh=None, suite=None, robust=None, budget=None,
+                 preflight: bool = True, fp_dedup: bool = True):
         from fks_tpu.sim import get_engine
 
         self.workload = workload
@@ -134,6 +136,16 @@ class CodeEvaluator:
         self._lock = threading.Lock()
         self.compile_count = 0  # observability: unique programs built
         self.vm_count = 0  # candidates served by the VM tier (no compile)
+        # Static pre-flight (fks_tpu.analysis.candidate): reject candidates
+        # the transpiler provably cannot lower BEFORE sandbox/transpile/
+        # compile spend anything on them, and collapse normalized-AST
+        # fingerprint duplicates within a batch onto one representative.
+        # Both paths emit ``candidate_rejected`` ledger events with a
+        # machine-readable taxonomy.
+        self.preflight = preflight
+        self.fp_dedup = fp_dedup
+        self.preflight_rejected = 0  # counters: ledger reads deltas
+        self.preflight_duplicates = 0
         # observability: host-loop segment dispatches from the segmented
         # batched runners (fks_tpu.obs ledger reads per-generation deltas)
         self.segments_dispatched = 0
@@ -470,18 +482,69 @@ class CodeEvaluator:
         completion order.
         """
         seg0 = self.segments_dispatched
+        pf_rejected = 0
+        fp_dupes = 0
+        works: List[int] = []  # static per-node work bounds (accepted)
+        fps: Dict[str, Optional[str]] = {}  # canonical key -> fingerprint
         keyed: List[Optional[str]] = []
         errors: Dict[int, EvalRecord] = {}
+        analysis = None
+        if self.preflight or self.fp_dedup:
+            # lazy: fks_tpu.analysis pulls funsearch tables, and
+            # funsearch/__init__ imports this module first
+            from fks_tpu import analysis
+        g_padded = self.workload.cluster.g_padded
         for i, code in enumerate(codes):
+            rep = None
+            if analysis is not None:
+                rep = analysis.preflight_check(code)
+                if self.preflight and not rep.ok:
+                    # statically doomed: never reaches sandbox.validate,
+                    # transpile, or any compile tier (pinned by tests)
+                    keyed.append(None)
+                    errors[i] = EvalRecord(
+                        code, 0.0, f"preflight: {rep.taxonomy}: {rep.reason}")
+                    obs.get_recorder().event(
+                        "candidate_rejected", taxonomy=rep.taxonomy,
+                        stage="preflight", reason=rep.reason[:200])
+                    pf_rejected += 1
+                    continue
+                if rep.ok and rep.cost is not None:
+                    works.append(rep.cost.work(g_padded))
             try:
-                keyed.append(transpiler.canonical_key(code))
+                key = transpiler.canonical_key(code)
             except SyntaxError as e:
                 keyed.append(None)
                 errors[i] = EvalRecord(code, 0.0, f"syntax: {e}")
+                continue
+            keyed.append(key)
+            if rep is not None and key not in fps:
+                fps[key] = rep.fingerprint
         unique: Dict[str, str] = {}
         for key, code in zip(keyed, codes):
             if key is not None and key not in unique:
                 unique[key] = code
+
+        # normalized-AST near-duplicate suppression (within this batch):
+        # fingerprint-colliding sources collapse onto one representative —
+        # one sandbox/transpile/compile/eval instead of k — and every
+        # echo still receives the representative's full EvalRecord
+        alias: Dict[str, str] = {}
+        if self.fp_dedup:
+            by_fp: Dict[str, str] = {}
+            for key in list(unique):
+                fp = fps.get(key)
+                if fp is None:
+                    continue
+                owner = by_fp.setdefault(fp, key)
+                if owner != key:
+                    alias[key] = owner
+                    del unique[key]
+                    fp_dupes += 1
+                    obs.get_recorder().event(
+                        "candidate_rejected",
+                        taxonomy="duplicate_fingerprint",
+                        stage="fp_dedup", reason=f"fingerprint {fp}")
 
         memo: Dict[str, EvalRecord] = {}
         vm_progs: Dict[str, vm.VMProgram] = {}
@@ -548,10 +611,16 @@ class CodeEvaluator:
 
         # observability: how this batch was served, for the evolution
         # ledger / flight recorder (host bookkeeping only — no device work)
+        self.preflight_rejected += pf_rejected
+        self.preflight_duplicates += fp_dupes
         self.last_eval_stats = {
             "candidates": len(codes),
             "unique": len(unique),
-            "syntax_failed": len(errors),
+            "syntax_failed": len(errors) - pf_rejected,
+            "preflight_rejected": pf_rejected,
+            "fingerprint_duplicates": fp_dupes,
+            "mean_static_work": (round(sum(works) / len(works), 1)
+                                 if works else 0),
             "vm_batch_lanes": batch_served,
             "fallback_lanes": len(jit_only) + len(general),
             "segments": self.segments_dispatched - seg0,
@@ -564,7 +633,7 @@ class CodeEvaluator:
             if key is None:
                 out.append(errors[i])
             else:
-                r = memo[key]
+                r = memo[alias.get(key, key)]
                 out.append(EvalRecord(code, r.score, r.error, r.result,
                                       r.scenario_scores, r.aggregation,
                                       r.budget_rung))

@@ -21,6 +21,7 @@ from __future__ import annotations
 import contextlib
 import dataclasses
 import difflib
+import functools
 import json
 import os
 import random
@@ -201,20 +202,30 @@ def _percentile(sorted_desc: Sequence[float], q: float) -> float:
 def _failure_counts(records) -> Tuple[int, int]:
     """(sandbox_failed, transpile_failed) breakdown of a generation's
     EvalRecords. Transpile-fail covers the static rejections ("syntax:",
-    "transpile:"); sandbox-fail covers everything that failed while
-    actually running — candidate exceptions ("runtime:") and simulated
-    aborts (gpu allocation aborted / event budget exceeded). Failed
-    candidates still enter selection at score 0 (reference semantics);
-    these counters are observational only."""
+    "transpile:", and the pre-flight analyzer's "preflight:" verdicts —
+    fks_tpu.analysis rejects are transpile failures caught early);
+    sandbox-fail covers everything that failed while actually running —
+    candidate exceptions ("runtime:") and simulated aborts (gpu
+    allocation aborted / event budget exceeded). Failed candidates still
+    enter selection at score 0 (reference semantics); these counters are
+    observational only."""
     sandbox = transpile = 0
     for r in records:
         if r.error is None:
             continue
-        if r.error.startswith(("syntax", "transpile")):
+        if r.error.startswith(("syntax", "transpile", "preflight")):
             transpile += 1
         else:
             sandbox += 1
     return sandbox, transpile
+
+
+@functools.lru_cache(maxsize=4096)
+def analysis_fingerprint(code: str) -> Optional[str]:
+    """Memoized normalized-AST fingerprint (fks_tpu.analysis). Incumbents
+    are fingerprinted once per process, not once per similarity check."""
+    from fks_tpu.analysis import fingerprint
+    return fingerprint(code)
 
 
 # ------------------------------------------------------------------ driver
@@ -323,8 +334,15 @@ class FunSearch:
         the evolved logic block, not the full source: every candidate shares
         the fixed template, which would dominate a full-string ratio."""
         logic = template.logic_of(code)
+        # normalized-AST fast path (fks_tpu.analysis): an exact fingerprint
+        # collision with any incumbent at >= score is a duplicate by
+        # construction (alpha-renames and same-decade coefficient jitter
+        # collide) — skip the quadratic difflib pass for it
+        fp = analysis_fingerprint(code)
         for other_code, other_score in self.population:
             if other_score >= score:
+                if fp is not None and fp == analysis_fingerprint(other_code):
+                    return True
                 ratio = difflib.SequenceMatcher(
                     None, logic, template.logic_of(other_code)).ratio()
                 if ratio >= self.cfg.similarity_threshold:

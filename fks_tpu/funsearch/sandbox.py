@@ -56,7 +56,10 @@ _ALLOWED_NODES = (
     ast.Assign, ast.AugAssign, ast.AnnAssign, ast.Expr, ast.Pass,
     ast.Return, ast.If, ast.IfExp, ast.For, ast.Compare, ast.BoolOp,
     ast.BinOp, ast.UnaryOp, ast.Call, ast.Attribute, ast.Name, ast.Constant,
-    ast.Tuple, ast.List, ast.Subscript, ast.Slice, ast.Index,
+    # NB: ast.Index is never produced on py3.9+ and ast.Slice (a[1:2])
+    # was dead weight — the transpiler rejects any non-static-int
+    # subscript, so slice syntax is denied here, one stage earlier
+    ast.Tuple, ast.List, ast.Subscript,
     ast.GeneratorExp, ast.comprehension, ast.keyword,
     ast.Load, ast.Store,
     ast.And, ast.Or, ast.Not,

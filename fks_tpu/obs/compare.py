@@ -65,6 +65,11 @@ DEFAULT_THRESHOLDS: Dict[str, Threshold] = {
     # at millisecond scale), and batched throughput must not drop >10%
     "serve_p99_ms": Threshold(higher_is_better=False, rel=0.25, abs_tol=2.0),
     "serve_qps": Threshold(higher_is_better=True, rel=0.10),
+    # static pre-flight (bench stage_preflight): the fraction of the
+    # candidate stream rejected before sandbox/transpile must not drop
+    # more than 5 points — a drop means the analyzer stopped catching a
+    # junk class it used to catch (absolute: the rate is already a ratio)
+    "preflight_reject_rate": Threshold(higher_is_better=True, abs_tol=0.05),
 }
 
 
@@ -98,7 +103,8 @@ def _from_run_dir(run_dir: str) -> Dict[str, float]:
             continue
         for key in ("evals_per_sec", "code_evals_per_sec",
                     "budget_speedup", "budget_champion_match",
-                    "scale1k_events_per_sec", "serve_qps"):
+                    "scale1k_events_per_sec", "serve_qps",
+                    "preflight_reject_rate"):
             v = _num(m.get(key))
             if v is not None:
                 out[key] = max(out.get(key, 0.0), v)
@@ -137,7 +143,7 @@ def _from_jsonl(path: str) -> Dict[str, float]:
                     "compile_seconds", "best_score", "median_score",
                     "parity_max_drift", "budget_speedup",
                     "budget_champion_match", "scale1k_events_per_sec",
-                    "serve_p99_ms", "serve_qps"):
+                    "serve_p99_ms", "serve_qps", "preflight_reject_rate"):
             v = _num(rec.get(key))
             if v is None:
                 continue

@@ -12,6 +12,11 @@ time) plus evaluator counter DELTAS for the generation:
 - ``vm_batches``        — batched one-launch-per-generation VM launches;
 - ``vm_segments``       — host-loop segment dispatches from the segmented
                           (sharded or single-device) batched path;
+- ``preflight_rejections``   — candidates the static pre-flight analyzer
+                          (fks_tpu.analysis) rejected before sandbox/
+                          transpile/compile spent anything on them;
+- ``fingerprint_duplicates`` — candidates collapsed onto a batch sibling
+                          by the normalized-AST fingerprint;
 - ``evals_per_sec``     — generation eval throughput (new candidates over
                           eval wall seconds).
 
@@ -34,6 +39,8 @@ EVALUATOR_COUNTERS = {
     "vm_count": "vm_candidates",
     "vm_batch_count": "vm_batches",
     "segments_dispatched": "vm_segments",
+    "preflight_rejected": "preflight_rejections",
+    "preflight_duplicates": "fingerprint_duplicates",
 }
 
 

@@ -292,7 +292,8 @@ class ServeEngine:
             self._resolve_policy(champion.code, n, g)
         self.prefilter_k = resolve_auto_prefilter(
             self.param_policy, self.params, n, g,
-            override=prefilter_k, recorder=self.recorder)
+            override=prefilter_k, recorder=self.recorder,
+            work_hint=self._static_work_hint(champion.code, g))
 
     @staticmethod
     def _resolve_policy(code: str, n: int, g: int):
@@ -309,6 +310,19 @@ class ServeEngine:
         except vm.VMUnsupported:
             policy = transpiler.transpile(code)
             return (lambda _p, pod, nodes: policy(pod, nodes)), None, "jit"
+
+    @staticmethod
+    def _static_work_hint(code: str, g: int) -> Optional[int]:
+        """Static per-node work bound from the pre-flight cost model, fed
+        to the prefilter auto-heuristic so trivially cheap champions skip
+        the runtime probe entirely. None (no hint) when the analyzer
+        cannot price the source — the heuristic then probes as before."""
+        from fks_tpu import analysis
+
+        rep = analysis.preflight_check(code)
+        if rep.ok and rep.cost is not None:
+            return rep.cost.work(g)
+        return None
 
     # ----- bucket plumbing
 

@@ -830,6 +830,12 @@ PREFILTER_COST_THRESHOLD_S = 2e-4
 #: below this node count the dense sweep is cheap regardless of policy
 #: cost and the gather bookkeeping cannot win it back
 PREFILTER_MIN_NODES = 256
+#: static per-node work bound (fks_tpu.analysis CostEstimate.work) below
+#: which a policy is trivially cheap — a handful of fused elementwise ops
+#: lands orders of magnitude under PREFILTER_COST_THRESHOLD_S, so the
+#: timing probe (which costs a full XLA compile) can be skipped outright.
+#: Template-derived code candidates (gpu loop + prologue) sit well above.
+PREFILTER_WORK_HINT_MIN = 16
 
 
 def probe_policy_cost(param_policy, params, n_padded: int, g_padded: int,
@@ -881,15 +887,25 @@ def auto_prefilter_k(n_padded: int, policy_cost_s: Optional[float], *,
 
 def resolve_auto_prefilter(param_policy, params, n_padded: int,
                            g_padded: int, *, override: Optional[int] = None,
-                           recorder=None, **heuristic_kw) -> int:
+                           recorder=None, work_hint: Optional[int] = None,
+                           **heuristic_kw) -> int:
     """``auto_prefilter_k`` with the timing probe run only when its answer
     can matter: an explicit override or a small node axis skips the
-    (compile-costing) probe entirely. Records a ``prefilter_auto`` event
-    on the given recorder so run dirs show why k was chosen."""
+    (compile-costing) probe entirely, and so does a static ``work_hint``
+    (fks_tpu.analysis ``CostEstimate.work``) proving the policy trivially
+    cheap — prefiltering never pays for cheap policies (PROFILE.md round
+    11), so there is nothing to measure. Records a ``prefilter_auto``
+    event on the given recorder so run dirs show why k was chosen."""
     if override is not None:
         return int(override)
     min_nodes = heuristic_kw.get("min_nodes", PREFILTER_MIN_NODES)
     if n_padded < min_nodes:
+        return 0
+    if work_hint is not None and work_hint < PREFILTER_WORK_HINT_MIN:
+        if recorder is not None:
+            recorder.event("prefilter_auto", policy_cost_s=None,
+                           work_hint=int(work_hint), chosen_k=0,
+                           n_padded=n_padded)
         return 0
     cost = probe_policy_cost(param_policy, params, n_padded, g_padded)
     chosen = auto_prefilter_k(n_padded, cost, **heuristic_kw)

@@ -32,6 +32,28 @@ def test_rejects_escapes(bad):
     assert not sandbox.validate(code)
 
 
+def test_rejects_lambda_at_ast_stage():
+    # 'lambda' is caught by the substring blacklist first; the AST stage
+    # (validate_structure) must ALSO deny it on its own — defense in
+    # depth for the node allowlist
+    code = ("def priority_function(pod, node):\n"
+            "    f = lambda: 1\n    return 1")
+    r = sandbox.validate_structure(code)
+    assert not r and "Lambda" in r.reason
+
+
+def test_rejects_starred_call_and_slice():
+    # neither Starred nor Slice is in the node allowlist (ast.Index /
+    # ast.Slice were dropped from it — Index is never produced on
+    # py3.9+, and slice syntax can never transpile)
+    r = sandbox.validate(template.fill_template("score = max(*node.gpus)"))
+    assert not r and "Starred" in r.reason
+    r = sandbox.validate(
+        "def priority_function(pod, node):\n"
+        "    x = node.gpus[0:1]\n    return 1")
+    assert not r and "Slice" in r.reason
+
+
 def test_rejects_wrong_signature():
     assert not sandbox.validate("def priority_function(a, b):\n    return 1")
     assert not sandbox.validate("def other(pod, node):\n    return 1")

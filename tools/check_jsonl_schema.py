@@ -61,6 +61,20 @@ EVENT_KIND_REQUIRED: Dict[str, Tuple[str, ...]] = {
     "compile": ("seconds",),
     "decision_trace": ("engine", "events"),
     "trace_diff": ("engines", "divergent"),
+    # static pre-flight analyzer (fks_tpu.analysis): one event per
+    # candidate rejected before the sandbox/transpile/compile pipeline —
+    # the taxonomy label is machine-readable and closed-vocabulary
+    "candidate_rejected": ("taxonomy", "stage"),
+}
+
+#: legal ``taxonomy`` values on a candidate_rejected event. This tool is
+#: stdlib-only by design, so the vocabulary is duplicated from
+#: fks_tpu.analysis.REJECT_TAXONOMY; tests/test_analysis.py pins the two
+#: copies against each other.
+CANDIDATE_REJECT_TAXONOMY = {
+    "syntax", "forbidden_construct", "bad_signature", "unsupported_syntax",
+    "unsupported_call", "bad_arity", "unknown_attribute", "loop_too_long",
+    "duplicate_fingerprint",
 }
 
 #: legal event kinds inside an embedded decision-trace row (must match
@@ -89,6 +103,9 @@ METRIC_KIND_REQUIRED: Dict[str, Tuple[str, ...]] = {
     # (occupancy), and which compiled shape bucket answered it
     "serve_request": ("request_id", "latency_ms", "batch_size",
                       "batch_occupancy", "bucket_pods", "bucket_lanes"),
+    # repo lint gate (cli lint --run-dir): the AST findings + jaxpr-pin
+    # drift messages and the overall verdict
+    "lint_report": ("paths", "findings", "pin_drift", "ok"),
 }
 
 #: an OpenMetrics sample line: name, optional {labels}, value, optional ts
@@ -154,7 +171,14 @@ def check_kinds(path: str, records: List[dict],
             raise SchemaError(
                 f"{path}: record {i + 1} (kind={rec.get('kind')!r}): "
                 f"missing {missing}")
-        if rec.get("kind") == "decision_trace":
+        if rec.get("kind") == "candidate_rejected":
+            tax = rec.get("taxonomy")
+            if tax not in CANDIDATE_REJECT_TAXONOMY:
+                raise SchemaError(
+                    f"{path}: record {i + 1}: unknown rejection taxonomy "
+                    f"{tax!r} (expect one of "
+                    f"{sorted(CANDIDATE_REJECT_TAXONOMY)})")
+        elif rec.get("kind") == "decision_trace":
             _check_embedded_events(path, i, rec.get("events", []))
         elif rec.get("kind") == "trace_diff":
             div = rec.get("first_divergence") or {}

@@ -28,6 +28,14 @@ answers for queries sliced from the golden trace must match the
 unbatched exact engine (score drift <= 1e-5, placements identical,
 exit 0). A drift here means the serving tier's lane stacking or
 scatter-back is corrupting answers. Recorded as ``serve_gate``.
+
+A LINT GATE follows: ``cli lint --cpu`` — the repo-wide JAX-invariant
+AST lints must be clean AND the pinned-jaxpr manifest
+(tests/fixtures/jaxpr_pins.json) must match the currently lowered
+programs (exit 0). Pin drift means a key entry point compiles a
+different program than the one the evidence was gathered on — re-pin
+with ``cli lint --write-pins`` only when the change is intentional.
+Recorded as ``lint_gate``.
 """
 from __future__ import annotations
 
@@ -111,6 +119,20 @@ def serve_gate() -> dict:
     return {"ok": ok, **detail}
 
 
+def lint_gate() -> dict:
+    """Repo lint + jaxpr-pin drift: ``cli lint --cpu`` must exit 0
+    (clean findings, no pin drift). Returns {"ok": bool, ...}."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "-m", "fks_tpu.cli", "lint", "--cpu"],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=600)
+    ok = proc.returncode == 0
+    detail = {"rc": proc.returncode}
+    if not ok:
+        detail["err"] = (proc.stderr or proc.stdout or "")[-500:]
+    return {"ok": ok, **detail}
+
+
 def main() -> int:
     rev = subprocess.run(["git", "rev-parse", "--short", "HEAD"],
                          capture_output=True, text=True, cwd=REPO
@@ -127,6 +149,9 @@ def main() -> int:
     vgate = serve_gate()
     if not vgate["ok"]:
         print(f"SERVE GATE FAILED: {vgate}", file=sys.stderr)
+    lgate = lint_gate()
+    if not lgate["ok"]:
+        print(f"LINT GATE FAILED: {lgate}", file=sys.stderr)
     t0 = time.time()
     proc = subprocess.run(
         [sys.executable, "-m", "pytest", "tests/", "-q",
@@ -137,12 +162,13 @@ def main() -> int:
     summary = tail[0] if tail else ""
     counts = {k: int(v) for v, k in re.findall(
         r"(\d+) (passed|failed|error|skipped|deselected|xfailed)", summary)}
-    gates_ok = gate["ok"] and tgate["ok"] and sgate["ok"] and vgate["ok"]
+    gates_ok = (gate["ok"] and tgate["ok"] and sgate["ok"] and vgate["ok"]
+                and lgate["ok"])
     rc = proc.returncode if gates_ok else (proc.returncode or 1)
     row = {"ts": round(time.time(), 1), "rev": rev, "rc": rc,
            "wall_s": wall, **counts, "obs_gate": gate,
            "trace_gate": tgate, "scale_gate": sgate, "serve_gate": vgate,
-           "summary": summary}
+           "lint_gate": lgate, "summary": summary}
     os.makedirs(os.path.dirname(OUT), exist_ok=True)
     with open(OUT, "a") as f:
         f.write(json.dumps(row) + "\n")
