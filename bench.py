@@ -72,13 +72,19 @@ double-buffered segmented runner), printing ``scale1k_events_per_sec``
 and a dense-vs-prefilter ``prefilter_speedup`` with a 1e-5
 fitness-parity gate built in.
 
-Fallback contract (round 6): when the device probe fails, the headline
-``value``/``vs_baseline`` stay 0.0 (nothing was measured THIS run), and
-the CURRENT round's TPU-session measurement — never a prior round's —
-rides along under ``banked_from`` with full provenance
+Fallback contract (round 6, revised round 14): when the device probe
+fails, the CURRENT round's TPU-session measurement — never a prior
+round's — rides along under ``banked_from`` with full provenance
 (benchmarks/results/round*_tpu.jsonl, highest round number only). Round
-5's variant promoted banked numbers into the headline, which a prior
-round's stale file could silently feed.
+5's variant promoted banked numbers into the headline unmarked, which a
+prior round's stale file could silently feed. Round 14 reintroduces a
+carried headline SAFELY: the last HEALTHY historical headline (via
+fks_tpu.obs.history.RunHistory) fills ``value``/``vs_baseline`` with an
+explicit ``stale_from_run`` provenance marker — obs.compare refuses a
+stale candidate (stale is admissible as a baseline denominator only)
+and obs.history marks stale records unhealthy, so a carried value can
+neither win a regression gate nor chain into the next fallback. With no
+healthy history either, ``value``/``vs_baseline`` stay 0.0.
 
 Contract hardening (round 3): the controller installs SIGTERM/SIGINT/
 SIGHUP handlers that print the fallback JSON line before exiting, so even
@@ -183,22 +189,31 @@ def _banked_measurement():
 
 
 def _fallback_json(error: str, failure_taxonomy=None) -> str:
-    """The benchmark's single-JSON-line contract, error form. The
-    headline ``value``/``vs_baseline`` stay 0.0 — a failed probe measured
-    nothing, and a banked number in the headline reads as a live result
-    to the take-the-JSON-line driver (rounds 3-5 oscillated between the
-    two failure modes). The current round's session-recorded measurement,
-    when one exists, rides along UNDER ``banked_from`` with full
-    provenance, so the round's evidence is preserved without being
-    mislabeled.
+    """The benchmark's single-JSON-line contract, error form. A failed
+    probe measured nothing THIS run, so the headline carries the last
+    HEALTHY historical headline under an explicit ``stale_from_run``
+    marker (module docstring, round 14) — downstream consumers that must
+    not treat it as live (obs.compare candidates, obs.history health)
+    key off that marker. The current round's session-recorded
+    measurement, when one exists, rides along UNDER ``banked_from`` with
+    full provenance. With neither, ``value``/``vs_baseline`` stay 0.0.
 
-    This runs inside the kill-signal write-ahead handler, so the banked
-    lookup is fully guarded: a filesystem race there must not cost the
-    single-JSON-line contract the handler exists to keep."""
+    This runs inside the kill-signal write-ahead handler, so both
+    lookups are fully guarded: a filesystem race (or a half-installed
+    fks_tpu import) there must not cost the single-JSON-line contract
+    the handler exists to keep."""
     try:
         banked, code_banked = _banked_measurement()
     except Exception:  # noqa: BLE001 — contract over provenance
         banked = code_banked = None
+    try:
+        from fks_tpu.obs.history import RunHistory
+        root = os.environ.get("FKS_BENCH_RESULTS_DIR") or os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "benchmarks", "results")
+        stale = RunHistory(root).last_healthy_headline()
+    except Exception:  # noqa: BLE001 — contract over provenance
+        stale = None
     payload = {"metric": METRIC, "value": 0.0, "unit": "evals/s",
                "vs_baseline": 0.0, "error": error}
     if failure_taxonomy:
@@ -210,15 +225,27 @@ def _fallback_json(error: str, failure_taxonomy=None) -> str:
             kinds[a["kind"]] = kinds.get(a["kind"], 0) + 1
         payload["failure_taxonomy"] = {"kinds": kinds,
                                        "attempts": failure_taxonomy}
+    if stale is not None:
+        payload["value"] = round(float(stale["value"]), 2)
+        payload["vs_baseline"] = round(
+            float(stale["value"]) / BASELINE_EVALS_PER_SEC, 3)
+        payload["stale_from_run"] = stale
     if banked is not None:
         payload["banked_from"] = banked
+    if stale is not None:
+        payload["note"] = ("no live probe this run; headline carried "
+                           "forward from the last healthy historical run "
+                           "(stale_from_run provenance) — NOT a live "
+                           "measurement")
+    elif banked is not None:
         payload["note"] = ("no live probe this run; the current round's "
                            "session measurement is reported under "
                            "banked_from only")
     else:
-        payload["note"] = ("no live measurement this run and no recorded "
-                           "session measurement found in the current "
-                           "round's benchmarks/results/round*_tpu.jsonl")
+        payload["note"] = ("no live measurement this run, no healthy "
+                           "historical headline, and no recorded session "
+                           "measurement in the current round's "
+                           "benchmarks/results/round*_tpu.jsonl")
     if code_banked is not None:
         payload["code_banked_from"] = code_banked
     return json.dumps(payload)
@@ -477,39 +504,47 @@ def stage_throughput(pop: int, chunk: int, reps: int, engine: str) -> int:
     ``compile_seconds`` is the TRUE XLA backend-compile time observed by
     the jax.monitoring listener (fks_tpu.obs.CompileWatcher), distinct
     from ``first_call_seconds`` (cold call: trace + lower + compile + run)
-    and ``steady_state_seconds`` (best timed rep, compile excluded)."""
+    and ``steady_state_seconds`` (best timed rep, compile excluded). The
+    payload also embeds a ``device_profile`` attribution record — the
+    shared StageProfiler (fks_tpu.obs.profiler) carves the stage into
+    setup / compile / h2d / steady with the compile split, pad-lane
+    occupancy, and est_flops_per_sec folded in — which the controller
+    carries into the headline payload."""
     import jax
     import numpy as np
 
     from fks_tpu.data import TraceParser
     from fks_tpu.models import parametric
-    from fks_tpu.obs import CompileWatcher
+    from fks_tpu.obs import CompileWatcher, StageProfiler
     from fks_tpu.parallel import make_population_eval
     from fks_tpu.sim.engine import SimConfig
 
     watcher = CompileWatcher().install()
+    prof = StageProfiler(scope="bench", watcher=watcher)
     dev = jax.devices()[0]
     log(f"device: {dev.platform} ({dev.device_kind}); "
         f"pop={pop} chunk={chunk} reps={reps} engine={engine}")
 
-    wl = TraceParser().parse_workload()
-    # 2x pods = the retry-free event count; 4x leaves headroom for normal
-    # retry traffic (retry-heavy champions reach ~28k events) while keeping
-    # one degenerate lane from holding its chunk to the 8x default budget
-    # (truncated lanes score 0; see module docstring).
-    cfg = SimConfig(max_steps=4 * wl.num_pods, track_ctime=False)
-    key = jax.random.PRNGKey(0)
-    params = parametric.init_population(key, pop, noise=0.1)
-    if engine == "fused":
-        from fks_tpu.sim import fused
-        ev = fused.make_fused_population_run(wl, cfg, lanes=min(64, chunk))
-    else:
-        ev = make_population_eval(wl, cfg=cfg, engine=engine)
+    with prof.stage("setup", engine=engine, pop=pop):
+        wl = TraceParser().parse_workload()
+        # 2x pods = the retry-free event count; 4x leaves headroom for
+        # normal retry traffic (retry-heavy champions reach ~28k events)
+        # while keeping one degenerate lane from holding its chunk to the
+        # 8x default budget (truncated lanes score 0; module docstring).
+        cfg = SimConfig(max_steps=4 * wl.num_pods, track_ctime=False)
+        key = jax.random.PRNGKey(0)
+        params = parametric.init_population(key, pop, noise=0.1)
+        if engine == "fused":
+            from fks_tpu.sim import fused
+            ev = fused.make_fused_population_run(wl, cfg,
+                                                 lanes=min(64, chunk))
+        else:
+            ev = make_population_eval(wl, cfg=cfg, engine=engine)
 
-    t0 = time.perf_counter()
-    res = ev(params[:chunk])
-    jax.block_until_ready(res.policy_score)
-    t_compile = time.perf_counter() - t0
+    with prof.stage("compile", chunk=chunk) as hc:
+        res = ev(params[:chunk])
+        hc.sync(res.policy_score)
+    t_compile = hc.record["wall_seconds"]
     n_trunc = int(np.asarray(res.truncated).sum())
     log(f"first chunk (compile+run): {t_compile:.1f}s; scores "
         f"[{float(np.min(res.policy_score)):.3f}, "
@@ -519,10 +554,11 @@ def stage_throughput(pop: int, chunk: int, reps: int, engine: str) -> int:
         # the CPU parity gate never executes Mosaic-compiled code, so gate
         # the fused kernel here: a small same-device population must match
         # the XLA flat engine (exact trajectories; f32 accumulators to ulp)
-        ncheck = min(8, chunk)
-        ref = make_population_eval(wl, cfg=cfg, engine="flat")(
-            params[:ncheck])
-        got = ev(params[:ncheck])
+        with prof.stage("fused-gate"):
+            ncheck = min(8, chunk)
+            ref = make_population_eval(wl, cfg=cfg, engine="flat")(
+                params[:ncheck])
+            got = ev(params[:ncheck])
         if not np.array_equal(np.asarray(got.scheduled_pods),
                               np.asarray(ref.scheduled_pods)) or \
            not np.allclose(np.asarray(got.policy_score),
@@ -539,25 +575,34 @@ def stage_throughput(pop: int, chunk: int, reps: int, engine: str) -> int:
     # the chunk width instead of re-jitting a smaller batch. Built once,
     # outside the timed loop, so host concat/transfer isn't charged to
     # the throughput number.
-    host_params = np.asarray(params)
-    batches = []
-    for lo in range(0, pop, chunk):
-        batch = host_params[lo:lo + chunk]
-        if batch.shape[0] < chunk:
-            batch = np.concatenate(
-                [batch, host_params[:chunk - batch.shape[0]]], axis=0)
-        batches.append(jax.device_put(batch))
-    jax.block_until_ready(batches)
+    with prof.stage("h2d") as hb:
+        host_params = np.asarray(params)
+        batches = []
+        for lo in range(0, pop, chunk):
+            batch = host_params[lo:lo + chunk]
+            if batch.shape[0] < chunk:
+                batch = np.concatenate(
+                    [batch, host_params[:chunk - batch.shape[0]]], axis=0)
+            batches.append(jax.device_put(batch))
+        hb.sync(batches)
 
+    cost = _cost_estimates(ev, batches[0])
+    launched = len(batches) * chunk
     times = []
-    for _ in range(reps):
-        t0 = time.perf_counter()
-        # dispatch every chunk before blocking: executions queue on the
-        # device back-to-back and the tunnel's per-call round trip is
-        # paid once, not once per chunk
-        scores = [ev(batch).policy_score for batch in batches]
-        jax.block_until_ready(scores)
-        times.append(time.perf_counter() - t0)
+    with prof.stage("steady", reps=reps, real_count=pop,
+                    padded_count=launched,
+                    pad_waste_fraction=round(1.0 - pop / launched, 4)) as hs:
+        if cost.get("cost_flops"):
+            # static per-chunk FLOPs x launches prices the steady stage
+            hs.annotate(cost_flops=cost["cost_flops"] * len(batches) * reps)
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            # dispatch every chunk before blocking: executions queue on
+            # the device back-to-back and the tunnel's per-call round trip
+            # is paid once, not once per chunk
+            scores = [ev(batch).policy_score for batch in batches]
+            hs.sync(scores)
+            times.append(time.perf_counter() - t0)
     best = min(times)
     log(f"steady-state: {best:.3f}s / {pop} evals "
         f"({[round(t, 3) for t in times]}); XLA backend compile "
@@ -574,7 +619,11 @@ def stage_throughput(pop: int, chunk: int, reps: int, engine: str) -> int:
         "node_prefilter_k": cfg.node_prefilter_k,
         "state_pack": cfg.state_pack,
         # static per-chunk XLA cost (flops / bytes) for the compiled eval
-        **_cost_estimates(ev, batches[0]),
+        **cost,
+        # per-stage device-time attribution (setup/compile/h2d/steady with
+        # the compile split, pad-lane occupancy and est_flops_per_sec);
+        # the controller carries it into the headline payload
+        "device_profile": prof.summary(),
     }))
     return 0
 
@@ -1412,9 +1461,11 @@ def main():
         "vs_baseline": round(evals_per_sec / BASELINE_EVALS_PER_SEC, 3),
     }
     # compile-vs-steady-state split from the winning throughput stage
-    # (PAPERS.md: evosax/Fast PBRL report the two separately; so do we)
+    # (PAPERS.md: evosax/Fast PBRL report the two separately; so do we),
+    # plus the embedded StageProfiler attribution record
     for k in ("compile_seconds", "backend_compiles", "first_call_seconds",
-              "steady_state_seconds", "cost_flops", "cost_bytes_accessed"):
+              "steady_state_seconds", "cost_flops", "cost_bytes_accessed",
+              "device_profile"):
         if k in stage_res:
             payload[k] = stage_res[k]
     if code_eps is not None:

@@ -331,6 +331,19 @@ def compute_pins() -> Dict[str, object]:
         pins[f"flat_step/{name}"] = _jaxpr_hash(
             step, flat.initial_state(wl, cfg))
 
+    # the StageProfiler is host-side only: the baseline step traced
+    # INSIDE an active profiler stage must hash identically to
+    # flat_step/baseline — pinned so a future profiler edit that leaks
+    # into tracing (a fence, a callback, a donated buffer) trips lint
+    from fks_tpu.obs.profiler import StageProfiler
+
+    cfg = SimConfig()
+    ktable, max_steps = loop_tables(wl, cfg)
+    step = flat.build_step(wl, policy, cfg, ktable, max_steps)
+    with StageProfiler(scope="lint") as _prof, _prof.stage("pin"):
+        pins["flat_step/profiled"] = _jaxpr_hash(
+            step, flat.initial_state(wl, cfg))
+
     # probe_score gates finalize (not the step program), so the flag's
     # off/on pair is pinned on the finalize lowering
     for name, kw in (("baseline", {}), ("probe_score", {"probe_score": True})):

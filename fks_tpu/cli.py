@@ -18,6 +18,7 @@ Usage:
     python -m fks_tpu.cli export-metrics RUN_DIR [--out F]
     python -m fks_tpu.cli watch RUN_DIR [--interval S] [--once]
     python -m fks_tpu.cli compare BASELINE CANDIDATE [--threshold m=rel:X]
+    python -m fks_tpu.cli trends ROOT [--metric m,...] [--fail-on-alert]
     python -m fks_tpu.cli trace-diff --engines exact,flat [--policy P | --code F]
     python -m fks_tpu.cli scenarios [--suite NAME [--scenario I]]
     python -m fks_tpu.cli lint [PATHS...] [--write-pins | --no-pins]
@@ -372,7 +373,8 @@ def cmd_evolve(args):
         fs = evo.run(wl, cfg, backend=backend,
                      sim_config=SimConfig(watchdog=args.watchdog),
                      checkpoint_path=args.checkpoint, out_dir=args.out,
-                     engine=args.engine, on_generation=on_gen)
+                     engine=args.engine, on_generation=on_gen,
+                     profile=args.profile)
         if fs.best:
             rec.annotate_meta(best_score=fs.best[1],
                               best_exact=fs.best_exact,
@@ -610,10 +612,15 @@ def cmd_serve(args):
             print(f"warm: {n} bucket programs compiled", file=sys.stderr)
         if args.save_artifact and not (args.queries or args.http):
             return 0  # artifact-build invocation, nothing to serve
+        slo = None
+        if args.slo_p99_ms or args.slo_qps:
+            from fks_tpu.obs.history import SLOConfig
+            slo = SLOConfig(p99_ms=args.slo_p99_ms, qps=args.slo_qps,
+                            error_budget=args.slo_error_budget)
         service = ServeService(engine, recorder=rec,
                                max_wait_s=args.max_wait_ms / 1e3,
                                audit_every=args.audit_every,
-                               audit_tol=args.audit_tol)
+                               audit_tol=args.audit_tol, slo=slo)
         try:
             if args.http:
                 print(f"listening on http://127.0.0.1:{args.http} "
@@ -692,20 +699,98 @@ def cmd_compare(args):
     """Cross-run regression gate: diff two run dirs (or bench JSONL files)
     on the shared metric vocabulary — throughput, compile seconds, fitness
     best/median, parity drift, watchdog violation counts — and exit 1 when
-    the candidate regresses past a threshold (fks_tpu.obs.compare)."""
+    the candidate regresses past a threshold (fks_tpu.obs.compare).
+    ``--baseline auto`` (the literal word as BASELINE) resolves the best
+    healthy historical run under ``--history-root`` instead of a
+    hand-picked path (fks_tpu.obs.history)."""
     from fks_tpu.obs import compare_runs, format_comparison, has_regression
     from fks_tpu.obs.compare import parse_threshold_overrides
 
+    baseline = args.baseline
+    if baseline == "auto":
+        from fks_tpu.obs.history import resolve_auto_baseline
+
+        root = args.history_root or _default_history_root()
+        baseline = resolve_auto_baseline(root)
+        if baseline is None:
+            print(f"error: no healthy historical run under {root} to "
+                  "auto-select as baseline", file=sys.stderr)
+            return 2
+        print(f"auto baseline: {baseline}", file=sys.stderr)
     try:
         thresholds = (parse_threshold_overrides(args.threshold)
                       if args.threshold else None)
-        rows = compare_runs(args.baseline, args.candidate,
+        rows = compare_runs(baseline, args.candidate,
                             thresholds=thresholds)
     except (FileNotFoundError, ValueError) as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
-    print(format_comparison(rows, args.baseline, args.candidate))
+    print(format_comparison(rows, baseline, args.candidate))
     return 1 if has_regression(rows) else 0
+
+
+def _default_history_root() -> str:
+    """benchmarks/results under the repo root — where bench.py banks
+    headline evidence and run_full_suite lands its rows."""
+    import os
+
+    return os.environ.get("FKS_BENCH_RESULTS_DIR") or os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "benchmarks", "results")
+
+
+def cmd_trends(args):
+    """Cross-run trend report (fks_tpu.obs.history): index every
+    flight-recorder run dir and bench evidence file under ROOT, render
+    per-metric timelines as sparklines, and flag regressions with the
+    robust z-score pass. Exit code contract: 0 = rendered (alerts print
+    but don't fail), 1 with ``--fail-on-alert`` when any metric alerted,
+    2 = bad/empty root — scriptable like ``compare``
+    (tools/run_full_suite.py's trends gate leans on it)."""
+    from fks_tpu.obs.history import RunHistory
+    from fks_tpu.obs.report import sparkline
+
+    try:
+        hist = RunHistory(args.root)
+        hist.scan()
+    except FileNotFoundError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    if not hist.entries:
+        print(f"error: no runs indexed under {args.root}", file=sys.stderr)
+        return 2
+    if args.write_index:
+        path = hist.write_index()
+        print(f"indexed {len(hist.entries)} entries -> {path}",
+              file=sys.stderr)
+    metrics = ([m.strip() for m in args.metric.split(",") if m.strip()]
+               if args.metric else None)
+    reports = hist.trends(metrics=metrics, window=args.window, z=args.z)
+    print(f"trend report: {len(hist.entries)} indexed entries "
+          f"under {args.root}")
+    total_alerts = 0
+    with _flight_recorder(args, "trends") as rec:
+        for rep in reports:
+            rec.metric("trend_report",
+                       {k: rep[k] for k in ("metric", "runs", "alerts",
+                                            "higher_is_better", "window",
+                                            "z", "values", "labels")})
+            arrow = ("higher=better" if rep["higher_is_better"]
+                     else "lower=better")
+            print(f"\n{rep['metric']}  ({rep['runs']} runs, {arrow})")
+            print(f"  {sparkline(rep['values'])}  latest "
+                  f"{rep['values'][-1]:g}")
+            for a in rep["alerts"]:
+                total_alerts += 1
+                print(f"  ALERT {a['direction']} at {a['run']}: "
+                      f"{a['value']:g} vs prior median {a['median']:g} "
+                      f"(robust z {a['z']:+.1f})")
+    if not reports:
+        print("\nno watched metrics present in the indexed entries")
+    print(f"\n{total_alerts} trend alert(s)")
+    if total_alerts and args.fail_on_alert:
+        return 1
+    return 0
 
 
 def cmd_trace_diff(args):
@@ -978,6 +1063,13 @@ def main(argv=None) -> int:
     e.add_argument("--probe-steps", type=int, default=None,
                    help="probe-rung event budget (truncated trace "
                         "prefix; 0 = full trace on the probe suite)")
+    e.add_argument("--profile", action="store_true",
+                   help="attribute wall time per pipeline stage (codegen/"
+                        "preflight/transpile/device-eval/rank/ledger) with "
+                        "compile-vs-compute split and lane occupancy — "
+                        "device_profile records in the run dir, rendered "
+                        "by 'report'. Off compiles identical programs "
+                        "(jaxpr-pinned)")
     e.set_defaults(fn=cmd_evolve)
 
     sc = sub.add_parser("scale", help="synthetic scale run + throughput",
@@ -1062,6 +1154,17 @@ def main(argv=None) -> int:
                          "against the unbatched exact engine (0 = off)")
     sv.add_argument("--audit-tol", type=float, default=1e-5,
                     help="audit/selftest score drift tolerance")
+    sv.add_argument("--slo-p99-ms", type=float, default=0.0,
+                    help="SLO: target p99 latency in ms (0 = unset); "
+                         "burn-rate records land as slo_burn metrics — "
+                         "'watch' alerts live, export-metrics publishes "
+                         "fks_slo_* gauges")
+    sv.add_argument("--slo-qps", type=float, default=0.0,
+                    help="SLO: target sustained queries/sec (0 = unset)")
+    sv.add_argument("--slo-error-budget", type=float, default=0.01,
+                    help="fraction of requests allowed over the p99 "
+                         "target (default 0.01; burn_rate = observed "
+                         "over-fraction / this budget)")
     sv.set_defaults(fn=cmd_serve)
 
     r = sub.add_parser("report",
@@ -1096,7 +1199,40 @@ def main(argv=None) -> int:
     c.add_argument("--threshold", default="",
                    help="comma-separated overrides, e.g. "
                         "'evals_per_sec=rel:0.2,best_score=abs:1e-4'")
+    c.add_argument("--history-root", default="",
+                   help="with BASELINE 'auto': the history root to select "
+                        "the best healthy run from (default: "
+                        "benchmarks/results, or $FKS_BENCH_RESULTS_DIR)")
     c.set_defaults(fn=cmd_compare)
+
+    tr = sub.add_parser(
+        "trends",
+        help="cross-run trend report over a directory of run dirs / bench "
+             "evidence (exit 1 with --fail-on-alert on regressions)")
+    tr.add_argument("root",
+                    help="directory holding flight-recorder run dirs "
+                         "and/or bench JSONL evidence files (e.g. "
+                         "benchmarks/results)")
+    tr.add_argument("--metric", default="",
+                    help="comma-separated metrics to watch (default: the "
+                         "built-in TREND_METRICS vocabulary)")
+    tr.add_argument("--window", type=int, default=5,
+                    help="prior-run window the robust median/MAD is "
+                         "computed over (default 5)")
+    tr.add_argument("--z", type=float, default=3.5,
+                    help="robust z-score threshold (MAD units, default "
+                         "3.5; the MAD is floored at 2%% of the median so "
+                         "flat series don't false-positive)")
+    tr.add_argument("--fail-on-alert", action="store_true",
+                    help="exit 1 when any watched metric alerts (the CI "
+                         "gate mode)")
+    tr.add_argument("--write-index", action="store_true",
+                    help="persist the scanned entries to ROOT/history.jsonl "
+                         "(atomic replace)")
+    tr.add_argument("--run-dir", default="",
+                    help="flight-recorder run directory for the "
+                         "trend_report records")
+    tr.set_defaults(fn=cmd_trends)
 
     td = sub.add_parser(
         "trace-diff",

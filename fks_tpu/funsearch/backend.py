@@ -88,10 +88,17 @@ class CodeEvaluator:
                  max_workers: Optional[int] = None, use_vm: bool = True,
                  engine: str = "exact", vm_batch: Optional[bool] = None,
                  mesh=None, suite=None, robust=None, budget=None,
-                 preflight: bool = True, fp_dedup: bool = True):
+                 preflight: bool = True, fp_dedup: bool = True,
+                 profiler=None):
         from fks_tpu.sim import get_engine
 
         self.workload = workload
+        # Device-time attribution (fks_tpu.obs.profiler): when an enabled
+        # StageProfiler is passed, evaluate() fences and attributes its
+        # sandbox+preflight / transpile / device-eval stages; the default
+        # NULL_PROFILER keeps every stage a no-op with no fences.
+        self.profiler = (profiler if profiler is not None
+                         else obs.NULL_PROFILER)
         self.cfg = cfg
         self.engine = engine
         self._mod = get_engine(engine)
@@ -241,6 +248,7 @@ class CodeEvaluator:
         runners (runs between device calls, never inside them)."""
         with self._lock:
             self.segments_dispatched += 1
+        self.profiler.segment_tick()
 
     def _vm_pop_runner(self):
         if self._vm_pop_run is None:
@@ -489,125 +497,151 @@ class CodeEvaluator:
         keyed: List[Optional[str]] = []
         errors: Dict[int, EvalRecord] = {}
         analysis = None
-        if self.preflight or self.fp_dedup:
-            # lazy: fks_tpu.analysis pulls funsearch tables, and
-            # funsearch/__init__ imports this module first
-            from fks_tpu import analysis
-        g_padded = self.workload.cluster.g_padded
-        for i, code in enumerate(codes):
-            rep = None
-            if analysis is not None:
-                rep = analysis.preflight_check(code)
-                if self.preflight and not rep.ok:
-                    # statically doomed: never reaches sandbox.validate,
-                    # transpile, or any compile tier (pinned by tests)
-                    keyed.append(None)
-                    errors[i] = EvalRecord(
-                        code, 0.0, f"preflight: {rep.taxonomy}: {rep.reason}")
-                    obs.get_recorder().event(
-                        "candidate_rejected", taxonomy=rep.taxonomy,
-                        stage="preflight", reason=rep.reason[:200])
-                    pf_rejected += 1
-                    continue
-                if rep.ok and rep.cost is not None:
-                    works.append(rep.cost.work(g_padded))
-            try:
-                key = transpiler.canonical_key(code)
-            except SyntaxError as e:
-                keyed.append(None)
-                errors[i] = EvalRecord(code, 0.0, f"syntax: {e}")
-                continue
-            keyed.append(key)
-            if rep is not None and key not in fps:
-                fps[key] = rep.fingerprint
         unique: Dict[str, str] = {}
-        for key, code in zip(keyed, codes):
-            if key is not None and key not in unique:
-                unique[key] = code
-
-        # normalized-AST near-duplicate suppression (within this batch):
-        # fingerprint-colliding sources collapse onto one representative —
-        # one sandbox/transpile/compile/eval instead of k — and every
-        # echo still receives the representative's full EvalRecord
         alias: Dict[str, str] = {}
-        if self.fp_dedup:
-            by_fp: Dict[str, str] = {}
-            for key in list(unique):
-                fp = fps.get(key)
-                if fp is None:
+        with self.profiler.stage("sandbox+preflight",
+                                 candidates=len(codes)) as hp:
+            if self.preflight or self.fp_dedup:
+                # lazy: fks_tpu.analysis pulls funsearch tables, and
+                # funsearch/__init__ imports this module first
+                from fks_tpu import analysis
+            g_padded = self.workload.cluster.g_padded
+            for i, code in enumerate(codes):
+                rep = None
+                if analysis is not None:
+                    rep = analysis.preflight_check(code)
+                    if self.preflight and not rep.ok:
+                        # statically doomed: never reaches sandbox.validate,
+                        # transpile, or any compile tier (pinned by tests)
+                        keyed.append(None)
+                        errors[i] = EvalRecord(
+                            code, 0.0,
+                            f"preflight: {rep.taxonomy}: {rep.reason}")
+                        obs.get_recorder().event(
+                            "candidate_rejected", taxonomy=rep.taxonomy,
+                            stage="preflight", reason=rep.reason[:200])
+                        pf_rejected += 1
+                        continue
+                    if rep.ok and rep.cost is not None:
+                        works.append(rep.cost.work(g_padded))
+                try:
+                    key = transpiler.canonical_key(code)
+                except SyntaxError as e:
+                    keyed.append(None)
+                    errors[i] = EvalRecord(code, 0.0, f"syntax: {e}")
                     continue
-                owner = by_fp.setdefault(fp, key)
-                if owner != key:
-                    alias[key] = owner
-                    del unique[key]
-                    fp_dupes += 1
-                    obs.get_recorder().event(
-                        "candidate_rejected",
-                        taxonomy="duplicate_fingerprint",
-                        stage="fp_dedup", reason=f"fingerprint {fp}")
+                keyed.append(key)
+                if rep is not None and key not in fps:
+                    fps[key] = rep.fingerprint
+            for key, code in zip(keyed, codes):
+                if key is not None and key not in unique:
+                    unique[key] = code
+
+            # normalized-AST near-duplicate suppression (within this
+            # batch): fingerprint-colliding sources collapse onto one
+            # representative — one sandbox/transpile/compile/eval instead
+            # of k — and every echo still receives the representative's
+            # full EvalRecord
+            if self.fp_dedup:
+                by_fp: Dict[str, str] = {}
+                for key in list(unique):
+                    fp = fps.get(key)
+                    if fp is None:
+                        continue
+                    owner = by_fp.setdefault(fp, key)
+                    if owner != key:
+                        alias[key] = owner
+                        del unique[key]
+                        fp_dupes += 1
+                        obs.get_recorder().event(
+                            "candidate_rejected",
+                            taxonomy="duplicate_fingerprint",
+                            stage="fp_dedup", reason=f"fingerprint {fp}")
+            hp.annotate(rejected=pf_rejected, duplicates=fp_dupes,
+                        unique=len(unique))
 
         memo: Dict[str, EvalRecord] = {}
         vm_progs: Dict[str, vm.VMProgram] = {}
         jit_only: Dict[str, str] = {}  # known outside the VM vocabulary
         general: Dict[str, str] = {}  # default tier choice (VM then jit)
         c = self.workload.cluster
-        if self.use_vm and self.vm_batch and len(unique) > 1:
-            for key, code in unique.items():
-                try:
-                    prog = vm.compile_policy(code, c.n_padded, c.g_padded)
-                    if prog.capacity > self.VM_CAPACITY:
-                        raise vm.VMUnsupported(
-                            f"program too long: capacity {prog.capacity}")
-                    vm_progs[key] = prog
-                except vm.VMUnsupported:
-                    jit_only[key] = code
-                except transpiler.TranspileError as e:
-                    memo[key] = EvalRecord(code, 0.0, f"transpile: {e}")
-                except Exception as e:  # noqa: BLE001 — untrusted code
-                    memo[key] = EvalRecord(code, 0.0, f"runtime: {e}")
-            if len(vm_progs) == 1:  # a population program for one lane
-                (key,) = vm_progs  # isn't worth it: unbatched VM tier
-                general[key] = unique[key]
-                vm_progs = {}
-        else:
-            general = dict(unique)
+        with self.profiler.stage("transpile") as ht:
+            if self.use_vm and self.vm_batch and len(unique) > 1:
+                for key, code in unique.items():
+                    try:
+                        prog = vm.compile_policy(code, c.n_padded,
+                                                 c.g_padded)
+                        if prog.capacity > self.VM_CAPACITY:
+                            raise vm.VMUnsupported(
+                                f"program too long: capacity "
+                                f"{prog.capacity}")
+                        vm_progs[key] = prog
+                    except vm.VMUnsupported:
+                        jit_only[key] = code
+                    except transpiler.TranspileError as e:
+                        memo[key] = EvalRecord(code, 0.0, f"transpile: {e}")
+                    except Exception as e:  # noqa: BLE001 — untrusted code
+                        memo[key] = EvalRecord(code, 0.0, f"runtime: {e}")
+                if len(vm_progs) == 1:  # a population program for one lane
+                    (key,) = vm_progs  # isn't worth it: unbatched VM tier
+                    general[key] = unique[key]
+                    vm_progs = {}
+            else:
+                general = dict(unique)
+            ht.annotate(vm_lanes=len(vm_progs),
+                        jit_fallback=len(jit_only) + len(general))
 
         batch_served = 0
         self.last_budget_stats = []
-        if vm_progs:
-            vm_keys = list(vm_progs)
-            try:
-                if self._budget_active(len(vm_keys)):
-                    recs = self._run_vm_batch_budget(
-                        [vm_progs[k] for k in vm_keys],
-                        [unique[k] for k in vm_keys])
-                    for key, rec in zip(vm_keys, recs):
-                        memo[key] = rec
-                else:
-                    results = self._run_vm_batch(
-                        [vm_progs[k] for k in vm_keys])
-                    for key, res in zip(vm_keys, results):
-                        memo[key] = self._record(unique[key], res)
-                batch_served = len(vm_keys)
-            except Exception as e:  # noqa: BLE001 — batch failed:
-                # per-candidate fallback still produces scores, but say
-                # WHY the one-launch-per-generation path is not engaging
-                from fks_tpu.utils import get_logger
-                get_logger("fks_tpu.funsearch.backend").warning(
-                    "batched VM launch failed (%s: %s); falling back to "
-                    "per-candidate evaluation", type(e).__name__, e)
-                for key in vm_keys:
-                    general.setdefault(key, unique[key])
+        with self.profiler.stage("device-eval") as hd:
+            if vm_progs:
+                vm_keys = list(vm_progs)
+                try:
+                    if self._budget_active(len(vm_keys)):
+                        recs = self._run_vm_batch_budget(
+                            [vm_progs[k] for k in vm_keys],
+                            [unique[k] for k in vm_keys])
+                        for key, rec in zip(vm_keys, recs):
+                            memo[key] = rec
+                    else:
+                        results = self._run_vm_batch(
+                            [vm_progs[k] for k in vm_keys])
+                        for key, res in zip(vm_keys, results):
+                            memo[key] = self._record(unique[key], res)
+                    batch_served = len(vm_keys)
+                except Exception as e:  # noqa: BLE001 — batch failed:
+                    # per-candidate fallback still produces scores, but say
+                    # WHY the one-launch-per-generation path is not engaging
+                    from fks_tpu.utils import get_logger
+                    get_logger("fks_tpu.funsearch.backend").warning(
+                        "batched VM launch failed (%s: %s); falling back "
+                        "to per-candidate evaluation", type(e).__name__, e)
+                    for key in vm_keys:
+                        general.setdefault(key, unique[key])
 
-        if jit_only or general:
-            with concurrent.futures.ThreadPoolExecutor(
-                    max_workers=self.max_workers) as ex:
-                futs = {key: ex.submit(self.evaluate_one, code, try_vm=False)
-                        for key, code in jit_only.items()}
-                futs.update({key: ex.submit(self.evaluate_one, code)
-                             for key, code in general.items()})
-                for key, f in futs.items():
-                    memo[key] = f.result()
+            if jit_only or general:
+                with concurrent.futures.ThreadPoolExecutor(
+                        max_workers=self.max_workers) as ex:
+                    futs = {key: ex.submit(self.evaluate_one, code,
+                                           try_vm=False)
+                            for key, code in jit_only.items()}
+                    futs.update({key: ex.submit(self.evaluate_one, code)
+                                 for key, code in general.items()})
+                    for key, f in futs.items():
+                        memo[key] = f.result()
+
+            # occupancy over the three batch axes (padded lanes x
+            # scenarios x trace segments): only the batched tier pads
+            # lanes; the threadpool fallback launches real work only
+            if batch_served:
+                from fks_tpu.parallel.mesh import occupancy_stats
+                hd.annotate(lanes=batch_served, **occupancy_stats(
+                    batch_served, self._n_shards,
+                    scenarios=len(self.suite) if self.suite else 1,
+                    segments=max(1, self.segments_dispatched - seg0)))
+            else:
+                hd.annotate(lanes=len(jit_only) + len(general),
+                            pad_waste_fraction=0.0)
 
         # observability: how this batch was served, for the evolution
         # ledger / flight recorder (host bookkeeping only — no device work)

@@ -240,9 +240,16 @@ class FunSearch:
                  log: Callable[[str], None] = print,
                  on_generation: Optional[
                      Callable[["GenerationStats"], None]] = None,
-                 recorder: Optional[obs.NullRecorder] = None):
+                 recorder: Optional[obs.NullRecorder] = None,
+                 profiler=None):
         self.cfg = config
         self.evaluator = evaluator
+        # device-time attribution (fks_tpu.obs.profiler): defaults to the
+        # evaluator's profiler so one StageProfiler wired through the
+        # evaluator attributes the whole loop — codegen / rank / ledger
+        # here, sandbox+preflight / transpile / device-eval in the backend
+        self.profiler = (profiler if profiler is not None
+                         else evaluator.profiler)
         self.rng = random.Random(config.seed)
         self.log = log
         # flight recorder: explicit > process-wide active (cli --run-dir
@@ -464,20 +471,22 @@ class FunSearch:
     def evolve_generation(self) -> GenerationStats:
         self.generation += 1
         cfg = self.cfg
-        self.ledger.begin_generation()
-        fallbacks0 = self.rescore_fallbacks
-        self._sort()
-        n_new = min(cfg.candidates_per_generation,
-                    max(0, cfg.population_size - cfg.elite_size))
-        feedback = ""
-        if self.best:
-            feedback = (f"best fitness so far {self.best[1]:.4f}; "
-                        "higher utilization with less GPU fragmentation wins")
-        with obs.span("llm", generation=self.generation,
-                      candidates=n_new) as lt:
-            codes = llm_mod.generate_many(
-                self.generator, n_new, self._sample_parents, feedback,
-                cfg.max_workers)
+        with self.profiler.stage("codegen", generation=self.generation):
+            self.ledger.begin_generation()
+            fallbacks0 = self.rescore_fallbacks
+            self._sort()
+            n_new = min(cfg.candidates_per_generation,
+                        max(0, cfg.population_size - cfg.elite_size))
+            feedback = ""
+            if self.best:
+                feedback = (
+                    f"best fitness so far {self.best[1]:.4f}; higher "
+                    "utilization with less GPU fragmentation wins")
+            with obs.span("llm", generation=self.generation,
+                          candidates=n_new) as lt:
+                codes = llm_mod.generate_many(
+                    self.generator, n_new, self._sample_parents, feedback,
+                    cfg.max_workers)
         llm_s = lt.seconds
 
         # plain wall time: evaluate() returns host floats (each candidate's
@@ -489,60 +498,76 @@ class FunSearch:
         eval_s = t.seconds
         sandbox_failed, transpile_failed = _failure_counts(records)
 
-        # eval-budget ledger: one budget_rung metric per rung (entered /
-        # survived / device-seconds / segment count), then the champion
-        # audit — pruning may never change who wins a generation, only
-        # how cheaply, and a violated audit alerts into the same exit-3
-        # policy as fitness-drift parity alerts
-        budget_rungs = list(
-            getattr(self.evaluator, "last_budget_stats", []) or [])
-        budget_alerts = 0
-        for rung in budget_rungs:
-            self.recorder.metric(
-                "budget_rung", generation=self.generation, **rung)
-        if budget_rungs:
-            budget_alerts = self.sentinel.check_champion(
-                self.generation, records)["alerts"]
+        with self.profiler.stage("rank", generation=self.generation) as hr:
+            # eval-budget ledger: one budget_rung metric per rung (entered
+            # / survived / device-seconds / segment count), then the
+            # champion audit — pruning may never change who wins a
+            # generation, only how cheaply, and a violated audit alerts
+            # into the same exit-3 policy as fitness-drift parity alerts
+            budget_rungs = list(
+                getattr(self.evaluator, "last_budget_stats", []) or [])
+            budget_alerts = 0
+            for rung in budget_rungs:
+                self.recorder.metric(
+                    "budget_rung", generation=self.generation, **rung)
+            if budget_rungs:
+                budget_alerts = self.sentinel.check_champion(
+                    self.generation, records)["alerts"]
 
-        # numerics watchdog: one event per generation carrying the OR of
-        # every evaluation's flag mask (always 0 when SimConfig.watchdog
-        # is off — the guards are compiled out)
-        wd_flags = 0
-        for r in records:
-            if r.result is not None:
-                wd_flags |= obs.combined_flags(
-                    getattr(r.result, "numeric_flags", 0))
-        if wd_flags:
-            self.recorder.event(
-                "watchdog", flags=wd_flags,
-                kinds=obs.describe_flags(wd_flags),
-                generation=self.generation, candidates=len(records))
+            # numerics watchdog: one event per generation carrying the OR
+            # of every evaluation's flag mask (always 0 when
+            # SimConfig.watchdog is off — the guards are compiled out)
+            wd_flags = 0
+            for r in records:
+                if r.result is not None:
+                    wd_flags |= obs.combined_flags(
+                        getattr(r.result, "numeric_flags", 0))
+            if wd_flags:
+                self.recorder.event(
+                    "watchdog", flags=wd_flags,
+                    kinds=obs.describe_flags(wd_flags),
+                    generation=self.generation, candidates=len(records))
 
-        accepted = rejected = 0
-        for r in records:
-            # subprocess-path semantics: failures carry score 0 and still
-            # enter selection (SURVEY.md §2 fine print 8)
-            if self._is_too_similar(r.code, r.score):
-                rejected += 1
-                continue
-            self._admit(r.code, r.score)
-            accepted += 1
-
-        if cfg.parametric_rounds > 0:
-            r = self._parametric_round()
-            if r is not None:
+            accepted = rejected = 0
+            for r in records:
+                # subprocess-path semantics: failures carry score 0 and
+                # still enter selection (SURVEY.md §2 fine print 8)
                 if self._is_too_similar(r.code, r.score):
                     rejected += 1
-                else:
-                    self._admit(r.code, r.score)
-                    accepted += 1
-        self._sort()
-        del self.population[cfg.population_size:]
+                    continue
+                self._admit(r.code, r.score)
+                accepted += 1
 
-        # parity sentinel: sample the post-truncation population (those
-        # are the members whose fitness selection actually trusts)
-        parity = self.sentinel.check(self.generation, self.population)
+            if cfg.parametric_rounds > 0:
+                r = self._parametric_round()
+                if r is not None:
+                    if self._is_too_similar(r.code, r.score):
+                        rejected += 1
+                    else:
+                        self._admit(r.code, r.score)
+                        accepted += 1
+            self._sort()
+            del self.population[cfg.population_size:]
 
+            # parity sentinel: sample the post-truncation population
+            # (those are the members whose fitness selection actually
+            # trusts)
+            parity = self.sentinel.check(self.generation, self.population)
+            hr.annotate(accepted=accepted, rejected_similar=rejected)
+
+        with self.profiler.stage("ledger", generation=self.generation):
+            stats = self._commit_generation(
+                codes, eval_s, llm_s, sandbox_failed, transpile_failed,
+                fallbacks0, wd_flags, parity, budget_alerts, budget_rungs,
+                accepted, rejected)
+        return stats
+
+    def _commit_generation(self, codes, eval_s, llm_s, sandbox_failed,
+                           transpile_failed, fallbacks0, wd_flags, parity,
+                           budget_alerts, budget_rungs, accepted,
+                           rejected) -> GenerationStats:
+        """Stats assembly + flight-recorder commit for one generation
+        (the ``ledger`` profiler stage of ``evolve_generation``)."""
         # scenario-suite bookkeeping: the champion's per-scenario breakdown
         # rides the stats/ledger, and one robust_fitness metric per
         # generation lands in the flight-recorder trail
@@ -620,7 +645,10 @@ class FunSearch:
         """Full loop -> (best_code, best_score) (reference:
         funsearch_integration.py:574-597)."""
         if not self.population:
-            self.initialize_population()
+            # a named stage (not codegen) so the backend's nested eval
+            # stages stay attributed to seeding, not the first generation
+            with self.profiler.stage("seed"):
+                self.initialize_population()
         while self.generation < self.cfg.generations:
             stats = self.evolve_generation()
             if stats.best_score >= self.cfg.early_stop_threshold:
@@ -767,15 +795,24 @@ def run(workload, config: Optional[EvolutionConfig] = None,
         log: Callable[[str], None] = print,
         on_generation: Optional[Callable[[GenerationStats], None]] = None,
         recorder: Optional[obs.NullRecorder] = None,
+        profile: bool = False,
         ) -> FunSearch:
     """Assemble evaluator + driver, optionally resuming from a checkpoint,
     and run to completion. Returns the driver for inspection.
+
+    ``profile=True`` attributes the run's wall time per pipeline stage
+    (fks_tpu.obs.profiler.StageProfiler): device_profile metrics into the
+    recorder trail plus a summary on the returned driver's
+    ``profiler.records``. Off is the default and compiles bit-identical
+    programs (the NULL profiler adds no fences — pinned by cli lint).
 
     A KeyboardInterrupt mid-evolution still persists champions (top-K +
     single best into ``out_dir``, reference: funsearch_integration.py:
     698-702) and the checkpoint — a long device run killed at the terminal
     must never lose its discoveries."""
     config = config or EvolutionConfig()
+    profiler = (obs.StageProfiler(scope="evolve", recorder=recorder)
+                if profile else obs.NULL_PROFILER)
     suite = robust = budget = None
     if config.scenario_suite:
         from fks_tpu.scenarios import RobustConfig, get_suite
@@ -794,10 +831,12 @@ def run(workload, config: Optional[EvolutionConfig] = None,
             + (f" @{budget.probe_steps} events" if budget.probe_steps
                else "")
             + f", top 1/{budget.eta} advance to the full suite")
-    fs = FunSearch(CodeEvaluator(workload, sim_config, engine=engine,
-                                 suite=suite, robust=robust, budget=budget),
-                   config, backend, log,
-                   on_generation=on_generation, recorder=recorder)
+    with profiler.stage("setup", engine=engine):
+        fs = FunSearch(CodeEvaluator(workload, sim_config, engine=engine,
+                                     suite=suite, robust=robust,
+                                     budget=budget, profiler=profiler),
+                       config, backend, log,
+                       on_generation=on_generation, recorder=recorder)
     if checkpoint_path and os.path.exists(checkpoint_path):
         fs.restore(checkpoint_path)
         log(f"resumed from {checkpoint_path} at generation {fs.generation}")
@@ -814,6 +853,16 @@ def run(workload, config: Optional[EvolutionConfig] = None,
         if checkpoint_path:
             fs.checkpoint(checkpoint_path)
         return fs
+    finally:
+        if profile:
+            # the __total__ device_profile record: per-stage attribution
+            # aggregate + the idle (unattributed) remainder of the run
+            summ = profiler.summary(emit=True)
+            log("device-time attribution: "
+                f"{summ['attributed_fraction'] * 100:.1f}% of "
+                f"{summ['measured_wall_seconds']:.2f}s wall attributed "
+                f"({summ['compile_seconds']:.2f}s compile); see cli report")
+            profiler.close()
     if checkpoint_path:
         fs.checkpoint(checkpoint_path)
     return fs

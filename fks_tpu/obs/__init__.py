@@ -25,6 +25,10 @@ jitted code.
                   (``cli export-metrics`` / ``cli watch``)
 - ``compare``   — cross-run regression gating (``cli compare``,
                   ``bench.py --gate``)
+- ``profiler``  — per-stage device-time attribution: wall/compile/
+                  compute split + occupancy (``device_profile`` metrics)
+- ``history``   — cross-run index, trend/regression flagging, auto
+                  baselines, SLO burn rates (``cli trends``)
 """
 from fks_tpu.obs.compare import (
     DEFAULT_THRESHOLDS, Threshold, compare_runs, extract_metrics,
@@ -33,7 +37,13 @@ from fks_tpu.obs.compare import (
 from fks_tpu.obs.exporter import (
     health_line, run_health, to_openmetrics, watch,
 )
+from fks_tpu.obs.history import (
+    RunHistory, SLOConfig, record_slo_burn, resolve_auto_baseline, slo_burn,
+)
 from fks_tpu.obs.ledger import EvolutionLedger
+from fks_tpu.obs.profiler import (
+    NULL_PROFILER, StageProfiler, profile_launch,
+)
 from fks_tpu.obs.recorder import (
     NULL, FlightRecorder, NullRecorder, get_recorder, recording,
 )
@@ -54,13 +64,15 @@ from fks_tpu.obs.watchdog import (
 
 __all__ = [
     "DEFAULT_THRESHOLDS", "FLAG_INF", "FLAG_NAN", "FLAG_RANGE", "NULL",
-    "CompileWatcher", "EvolutionLedger", "FlightRecorder", "NullRecorder",
-    "ParitySentinel", "Threshold", "align_traces", "candidate_trace_diff",
+    "NULL_PROFILER", "CompileWatcher", "EvolutionLedger", "FlightRecorder",
+    "NullRecorder", "ParitySentinel", "RunHistory", "SLOConfig",
+    "StageProfiler", "Threshold", "align_traces", "candidate_trace_diff",
     "check_result", "combined_flags", "compare_runs", "describe_flags",
     "device_snapshot", "extract_metrics", "extract_trace",
     "format_comparison", "format_diff", "get_recorder", "has_regression",
     "health_line", "mesh_snapshot", "parse_threshold_overrides",
-    "record_devices", "record_mesh", "recording", "render_report",
-    "run_health", "span", "span_path", "sparkline", "to_openmetrics",
+    "profile_launch", "record_devices", "record_mesh", "record_slo_burn",
+    "recording", "render_report", "resolve_auto_baseline", "run_health",
+    "slo_burn", "span", "span_path", "sparkline", "to_openmetrics",
     "trace_diff", "watch", "watch_compiles",
 ]

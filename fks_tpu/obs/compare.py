@@ -130,12 +130,16 @@ def _from_run_dir(run_dir: str) -> Dict[str, float]:
     return out
 
 
-def _from_jsonl(path: str) -> Dict[str, float]:
+def _from_jsonl(path: str, allow_stale: bool = False) -> Dict[str, float]:
     """Best metrics out of a bench JSONL: the headline contract line maps
     ``value`` (unit evals/s) onto ``evals_per_sec``; session-log rows
     (``{"ok", "stage", "result": {...}}``) contribute their result dict;
     a 0.0-with-``banked_from`` fallback line contributes NOTHING to the
-    headline throughput (nothing was measured that run)."""
+    headline throughput (nothing was measured that run). A STALE headline
+    (``stale_from_run`` marker: a failed probe carrying the last healthy
+    historical value, fks_tpu.obs.history) counts only when
+    ``allow_stale`` — as a BASELINE denominator it is real evidence, as a
+    candidate it would mask the very failure it records."""
     out: Dict[str, float] = {}
 
     def take(rec: Dict[str, Any]) -> None:
@@ -165,8 +169,9 @@ def _from_jsonl(path: str) -> Dict[str, float]:
                 continue
             if rec.get("unit") == "evals/s" and "value" in rec:
                 v = _num(rec["value"])
-                # the fallback contract: value 0.0 means "not measured"
-                if v:
+                # the fallback contract: value 0.0 means "not measured";
+                # stale (carried-forward) values count for baselines only
+                if v and (allow_stale or "stale_from_run" not in rec):
                     out["evals_per_sec"] = max(
                         out.get("evals_per_sec", 0.0), v)
             take(rec)
@@ -175,11 +180,13 @@ def _from_jsonl(path: str) -> Dict[str, float]:
     return out
 
 
-def extract_metrics(path: str) -> Dict[str, float]:
-    """The comparator's metric vocabulary for a run dir or a JSONL file."""
+def extract_metrics(path: str, allow_stale: bool = False) -> Dict[str, float]:
+    """The comparator's metric vocabulary for a run dir or a JSONL file.
+    ``allow_stale`` admits carried-forward bench headlines (baseline
+    side only — see ``_from_jsonl``)."""
     if os.path.isdir(path):
         return _from_run_dir(path)
-    return _from_jsonl(path)
+    return _from_jsonl(path, allow_stale=allow_stale)
 
 
 def _judge(name: str, a: float, b: float, th: Threshold) -> str:
@@ -203,7 +210,10 @@ def compare_runs(baseline: str, candidate: str,
     either: ``{"metric", "baseline", "candidate", "status"}`` with status
     OK / IMPROVED / REGRESSION / BASELINE-ONLY / CANDIDATE-ONLY."""
     thresholds = thresholds if thresholds is not None else DEFAULT_THRESHOLDS
-    a = extract_metrics(baseline)
+    # stale asymmetry: a carried-forward headline is a legitimate
+    # DENOMINATOR (the last healthy measurement) but never a legitimate
+    # candidate (it would hide the failed probe it stands in for)
+    a = extract_metrics(baseline, allow_stale=True)
     b = extract_metrics(candidate)
     rows: List[Dict[str, Any]] = []
     for name in sorted(set(a) | set(b), key=lambda n: (

@@ -168,6 +168,39 @@ def to_openmetrics(run_dir: str) -> str:
                     f"bench stage {key}").add(
                     s[key], run_id=run_id, stage=stage)
 
+    # device-time attribution (fks_tpu.obs.profiler): per-stage split
+    for d in (m for m in metrics if m.get("kind") == "device_profile"):
+        stage = d.get("stage", "?")
+        if stage == "__total__":
+            fam("profile_attributed_fraction", "gauge",
+                "share of measured wall attributed to profiler stages").add(
+                d.get("attributed_fraction"), run_id=run_id,
+                scope=d.get("scope"))
+            fam("profile_idle_fraction", "gauge",
+                "share of measured wall unattributed (idle/gaps)").add(
+                d.get("idle_fraction"), run_id=run_id, scope=d.get("scope"))
+            continue
+        for key in ("wall_seconds", "compile_seconds", "compute_seconds",
+                    "utilization_pct"):
+            if key in d:
+                fam(f"profile_stage_{key}", "gauge",
+                    f"device-time attribution: stage {key}").add(
+                    d[key], run_id=run_id, stage=stage, scope=d.get("scope"))
+
+    # SLO burn rates (fks_tpu.obs.history.slo_burn): latest record per SLO
+    latest_burn: Dict[str, dict] = {}
+    for b in (m for m in metrics if m.get("kind") == "slo_burn"):
+        latest_burn[str(b.get("slo", "?"))] = b
+    for name in sorted(latest_burn):
+        b = latest_burn[name]
+        fam("slo_burn_rate", "gauge",
+            "error-budget burn rate (>1 = violating the SLO)").add(
+            b.get("burn_rate"), run_id=run_id, slo=name)
+        fam("slo_target", "gauge", "declared SLO target").add(
+            b.get("target"), run_id=run_id, slo=name)
+        fam("slo_observed", "gauge", "observed SLI value").add(
+            b.get("observed"), run_id=run_id, slo=name)
+
     counts: Dict[str, int] = {}
     for e in events:
         kind = e.get("kind", "?")
@@ -284,6 +317,14 @@ def watch(run_dir: str, interval: float = 5.0, once: bool = False,
             elif kind == "bench_stage":
                 v = m.get("value", m.get("evals_per_sec"))
                 out.write(f"bench {m.get('stage', '?')}: {v}\n")
+            elif kind == "slo_burn":
+                rate = _num(m.get("burn_rate")) or 0.0
+                line = (f"slo {m.get('slo', '?')}: burn {rate:.2f}x "
+                        f"(observed {m.get('observed')} vs target "
+                        f"{m.get('target')})")
+                if rate > 1.0:
+                    line = "SLO ALERT " + line
+                out.write(line + "\n")
         h = run_health(run_dir, meta=meta, metrics=metrics)
         age = "-" if h["age"] is None else f"{h['age']:.0f}s"
         out.write(f"[{h['state']}] status={meta.get('status', '?')} "

@@ -191,6 +191,77 @@ def _budget_section(metrics: List[Dict[str, Any]]) -> List[str]:
     return lines
 
 
+def _device_profile_section(metrics: List[Dict[str, Any]]) -> List[str]:
+    """Device-time attribution table (fks_tpu.obs.profiler): stages
+    aggregated by name and ranked by wall share, each split into compile
+    vs dispatch+compute, with occupancy-discounted utilization where the
+    launch shape was annotated; the ``__total__`` record (when the run
+    emitted a summary) heads the section with the attributed-vs-idle
+    verdict."""
+    profs = [m for m in metrics if m.get("kind") == "device_profile"]
+    if not profs:
+        return []
+    totals = [m for m in profs if m.get("stage") == "__total__"]
+    stages = [m for m in profs
+              if m.get("stage") != "__total__" and not m.get("depth", 0)]
+    agg: Dict[str, Dict[str, float]] = {}
+    for m in stages:
+        a = agg.setdefault(m.get("stage", "?"), {
+            "count": 0, "wall": 0.0, "compile": 0.0, "compute": 0.0,
+            "compiles": 0, "util": None})
+        a["count"] += 1
+        a["wall"] += float(m.get("wall_seconds", 0.0))
+        a["compile"] += float(m.get("compile_seconds", 0.0))
+        a["compute"] += float(m.get("compute_seconds", 0.0))
+        a["compiles"] += int(m.get("compile_count", 0))
+        if m.get("utilization_pct") is not None:
+            a["util"] = max(a["util"] or 0.0, float(m["utilization_pct"]))
+    total_wall = sum(a["wall"] for a in agg.values())
+    lines = ["device-time attribution (obs.profiler):"]
+    for t in totals[-1:]:
+        lines.append(
+            f"  attributed {100 * float(t.get('attributed_fraction', 0)):.1f}%"
+            f" of {_num(float(t.get('measured_wall_seconds', 0.0)), 3)}s wall"
+            f" ({100 * float(t.get('idle_fraction', 0)):.1f}% idle, "
+            f"compile {_num(float(t.get('compile_seconds', 0.0)), 3)}s)")
+    rows = [{
+        "stage": name,
+        "n": int(a["count"]),
+        "wall_s": _num(a["wall"], 3),
+        "%wall": _num(100 * a["wall"] / total_wall, 1) if total_wall else 0,
+        "compile_s": _num(a["compile"], 3),
+        "compute_s": _num(a["compute"], 3),
+        "compiles": int(a["compiles"]),
+        "util%": "" if a["util"] is None else _num(a["util"], 1),
+    } for name, a in sorted(agg.items(), key=lambda kv: -kv[1]["wall"])]
+    if rows:
+        lines += _fmt_table(rows, ["stage", "n", "wall_s", "%wall",
+                                   "compile_s", "compute_s", "compiles",
+                                   "util%"])
+    return lines
+
+
+def _slo_section(metrics: List[Dict[str, Any]]) -> List[str]:
+    """Latest burn rate per SLO (fks_tpu.obs.history.slo_burn): burn > 1
+    means the error budget is being consumed faster than allowed."""
+    burns = [m for m in metrics if m.get("kind") == "slo_burn"]
+    if not burns:
+        return []
+    latest: Dict[str, Dict[str, Any]] = {}
+    for b in burns:
+        latest[str(b.get("slo", "?"))] = b
+    lines = ["SLO burn rates:"]
+    for name in sorted(latest):
+        b = latest[name]
+        rate = float(b.get("burn_rate", 0.0))
+        verdict = "VIOLATING" if rate > 1.0 else "ok"
+        lines.append(
+            f"  {name}: burn {rate:.2f}x (observed "
+            f"{_num(float(b.get('observed', 0.0)), 3)} vs target "
+            f"{_num(float(b.get('target', 0.0)), 3)}) {verdict}")
+    return lines
+
+
 def _bench_section(metrics: List[Dict[str, Any]]) -> List[str]:
     stages = [m for m in metrics if m.get("kind") == "bench_stage"]
     lines = []
@@ -254,6 +325,7 @@ def render_report(run_dir: str) -> str:
     lines.extend(_trace_diff_lines(events))
     for section in (_infra_section(events), _generation_section(metrics),
                     _budget_section(metrics), _bench_section(metrics),
+                    _device_profile_section(metrics), _slo_section(metrics),
                     _compile_section(events), _span_section(events)):
         if section:
             lines.append("")

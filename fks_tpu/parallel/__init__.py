@@ -10,5 +10,6 @@ from fks_tpu.parallel.population import (  # noqa: F401
 from fks_tpu.parallel.mesh import (  # noqa: F401
     DCN_AXIS, POP_AXIS, hybrid_population_mesh, init_distributed,
     make_sharded_code_eval, make_sharded_eval, make_sharded_generation_step,
-    num_shards, pad_population, population_mesh, shard_population,
+    num_shards, occupancy_stats, pad_population, pad_stats,
+    population_mesh, shard_population,
 )

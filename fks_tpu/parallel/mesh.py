@@ -175,6 +175,25 @@ def pad_stats(real_count: int, num_shards) -> dict:
     }
 
 
+def occupancy_stats(real_count: int, num_shards, scenarios: int = 1,
+                    segments: int = 1) -> dict:
+    """``pad_stats`` extended with the other two batch axes a launch
+    multiplies over — scenarios (``scenarios.suite`` vmap) and trace
+    segments (the segmented runner's host loop) — for the device-time
+    attribution profiler (fks_tpu.obs.profiler): ``launched_lane_steps``
+    is the total lane-dispatch count, ``real_lane_steps`` the share that
+    was real candidates. Pad waste is per-lane, so it is unchanged by
+    the extra axes; they scale the absolute accounting only."""
+    s = pad_stats(real_count, num_shards)
+    scenarios = max(1, int(scenarios))
+    segments = max(1, int(segments))
+    s["scenarios"] = scenarios
+    s["segments"] = segments
+    s["launched_lane_steps"] = s["padded_count"] * scenarios * segments
+    s["real_lane_steps"] = s["real_count"] * scenarios * segments
+    return s
+
+
 def shard_population(params, mesh: Mesh):
     """``device_put`` every leaf of a candidate pytree with its leading
     (candidate) axis sharded over the mesh's pop axes. Identity layout for

@@ -270,7 +270,7 @@ class ServeEngine:
                  prefilter_k: Optional[int] = None,
                  state_pack: bool = False,
                  max_steps_factor: int = 8,
-                 recorder=None):
+                 recorder=None, profiler=None):
         if engine == "fused":
             raise ValueError(
                 "the fused kernel evaluates parametric populations only; "
@@ -283,6 +283,12 @@ class ServeEngine:
         self.state_pack = bool(state_pack)
         self.max_steps_factor = int(max_steps_factor)
         self.recorder = recorder if recorder is not None else obs.get_recorder()
+        # device-time attribution (fks_tpu.obs.profiler): with an enabled
+        # StageProfiler every bucket compile, warmup sweep, and steady
+        # batch is a fenced device_profile stage; the default NULL
+        # profiler adds no fences and no conditionals to the serve path
+        self.profiler = (profiler if profiler is not None
+                         else obs.NULL_PROFILER)
         self._mod = get_engine(engine)
         self._compiled: Dict[Tuple[int, int], Any] = {}
         self.cold_compiles = 0
@@ -394,11 +400,12 @@ class ServeEngine:
         hit = self._compiled.get(key)
         if hit is not None:
             return hit
-        with obs.span("serve_compile", lanes=lanes, pods=pod_bucket,
-                      engine=self.engine_name):
-            example = self._example_batch(lanes, pod_bucket)
-            compiled = jax.jit(
-                self._make_serve_fn(pod_bucket)).lower(*example).compile()
+        with self.profiler.stage("compile", lanes=lanes, pods=pod_bucket):
+            with obs.span("serve_compile", lanes=lanes, pods=pod_bucket,
+                          engine=self.engine_name):
+                example = self._example_batch(lanes, pod_bucket)
+                compiled = jax.jit(
+                    self._make_serve_fn(pod_bucket)).lower(*example).compile()
         self._compiled[key] = compiled
         self.cold_compiles += 1
         return compiled
@@ -407,9 +414,10 @@ class ServeEngine:
                pod_buckets: Optional[Sequence[int]] = None) -> int:
         """Eagerly compile every (lane, pod) bucket combination (or the
         given subsets). Returns the number of executables now resident."""
-        for lb in lane_buckets or self.envelope.lane_buckets():
-            for pb in pod_buckets or self.envelope.pod_buckets():
-                self.compiled_for(lb, pb)
+        with self.profiler.stage("warmup"):
+            for lb in lane_buckets or self.envelope.lane_buckets():
+                for pb in pod_buckets or self.envelope.pod_buckets():
+                    self.compiled_for(lb, pb)
         return len(self._compiled)
 
     # ----- answering
@@ -442,10 +450,14 @@ class ServeEngine:
                                 self._klen(bucket))
         (wl, kt, s0), real = pad_population(stacked, lanes)
         compiled = self.compiled_for(lanes, bucket)
-        with obs.span("serve_batch", lanes=lanes, bucket_pods=bucket,
-                      real=real) as t:
-            res = compiled(wl, kt, s0)
-            t.sync(res.policy_score)
+        from fks_tpu.parallel.mesh import occupancy_stats
+        with self.profiler.stage("steady", **occupancy_stats(real, lanes)) \
+                as hs:
+            with obs.span("serve_batch", lanes=lanes, bucket_pods=bucket,
+                          real=real) as t:
+                res = compiled(wl, kt, s0)
+                t.sync(res.policy_score)
+            hs.sync(res.policy_score)
         res = jax.device_get(res)
         for lane, i in enumerate(idxs):
             answers[i] = self._extract(res, lane, len(pod_lists[i]),

@@ -23,6 +23,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from fks_tpu import obs
+from fks_tpu.obs.history import SLOConfig, record_slo_burn
 from fks_tpu.obs.watchdog import ParitySentinel
 from fks_tpu.serve.artifact import ServeEngine
 from fks_tpu.serve.batcher import RequestBatcher, pods_to_dicts
@@ -40,10 +41,18 @@ class ServeService:
 
     def __init__(self, engine: ServeEngine, *, recorder=None,
                  max_batch: Optional[int] = None, max_wait_s: float = 0.005,
-                 audit_every: int = 0, audit_tol: float = 1e-5):
+                 audit_every: int = 0, audit_tol: float = 1e-5,
+                 slo: Optional[SLOConfig] = None, slo_every: int = 100):
         self.engine = engine
         self.recorder = recorder if recorder is not None else obs.get_recorder()
         self.audit_every = int(audit_every)
+        # serve-tier SLO (fks_tpu.obs.history.SLOConfig): p99/qps targets
+        # priced as error-budget burn rates — one slo_burn metric every
+        # ``slo_every`` requests plus one at summary(), so ``cli watch``
+        # alerts live and the exporter publishes fks_slo_* gauges
+        self.slo = slo if slo is not None else SLOConfig()
+        self.slo_every = max(1, int(slo_every))
+        self._slo_marks = 0
         self.sentinel = ParitySentinel(None, tol=audit_tol,
                                        recorder=self.recorder)
         self._batcher = RequestBatcher(
@@ -120,6 +129,12 @@ class ServeService:
             if self.audit_every > 0 and \
                     len(self._latencies_ms) % self.audit_every == 0:
                 self._audit(rid, pods, ans)
+        if (self.slo.enabled
+                and len(self._latencies_ms) // self.slo_every
+                > self._slo_marks):
+            self._slo_marks = len(self._latencies_ms) // self.slo_every
+            record_slo_burn(self.slo, self._latencies_ms,
+                            self._elapsed(), recorder=self.recorder)
         return answers
 
     def _audit(self, rid: str, pods: List[dict], ans: dict) -> None:
@@ -133,10 +148,13 @@ class ServeService:
 
     # ----- stats
 
+    def _elapsed(self) -> float:
+        return (self._t_last - self._t_first) \
+            if self._t_first is not None else 0.0
+
     def summary(self, record: bool = True) -> dict:
         lat = np.asarray(self._latencies_ms, np.float64)
-        elapsed = (self._t_last - self._t_first) \
-            if self._t_first is not None else 0.0
+        elapsed = self._elapsed()
         out = {
             "requests": len(lat),
             "batches": self._batcher.batches,
@@ -150,8 +168,13 @@ class ServeService:
             "audits": self.audits,
             "audit_failures": self.audit_failures,
         }
+        if self.slo.enabled:
+            out["slo"] = record_slo_burn(
+                self.slo, self._latencies_ms, elapsed,
+                recorder=self.recorder if record else obs.NULL)
         if record:
-            self.recorder.metric("serve", **out)
+            self.recorder.metric("serve", **{k: v for k, v in out.items()
+                                             if k != "slo"})
         return out
 
 

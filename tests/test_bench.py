@@ -1,6 +1,8 @@
 """bench.py stage wiring (fast tier): the code-candidate throughput stage
 runs in-process on the conftest 8-virtual-device mesh, and the fallback
-contract only surfaces CURRENT-round session measurements.
+contract banks only CURRENT-round session measurements while the headline
+carries the last healthy historical value under stale_from_run
+provenance (round 14 — see the bench.py module docstring).
 
 The heavy stages (flat/fused parametric throughput) need the full trace
 and are exercised by the TPU measurement session; here the codetput stage
@@ -66,10 +68,11 @@ def _write_round(results_dir, n, records):
 @pytest.fixture
 def banked_repo(tmp_path, monkeypatch):
     """Point bench's results directory at a temp tree (it is derived from
-    the module's __file__)."""
+    the module's __file__; the env override must not leak in either)."""
     results = tmp_path / "benchmarks" / "results"
     results.mkdir(parents=True)
     monkeypatch.setattr(bench, "__file__", str(tmp_path / "bench.py"))
+    monkeypatch.delenv("FKS_BENCH_RESULTS_DIR", raising=False)
     return results
 
 
@@ -100,9 +103,12 @@ def test_banked_measurement_empty_results(banked_repo):
     assert bench._banked_measurement() == (None, None)
 
 
-def test_fallback_json_keeps_headline_zero(banked_repo):
-    """A failed probe reports value/vs_baseline 0.0; the current round's
-    session measurement rides along under banked_from only."""
+def test_fallback_json_carries_stale_headline(banked_repo):
+    """Round 14 revision of the round-6 contract: a failed probe's
+    headline carries the last HEALTHY historical value under an explicit
+    ``stale_from_run`` marker (here the session's own round file is the
+    newest healthy donor); the current round's session measurement still
+    rides along under banked_from."""
     _write_round(banked_repo, 6, [
         {"ok": True, "stage": "flatseed", "ts": 2,
          "result": {"evals_per_sec": 321.0}},
@@ -110,11 +116,13 @@ def test_fallback_json_keeps_headline_zero(banked_repo):
          "result": {"code_evals_per_sec": 7.5}},
     ])
     payload = json.loads(bench._fallback_json("tunnel wedged"))
-    assert payload["value"] == 0.0 and payload["vs_baseline"] == 0.0
+    assert payload["value"] == 321.0
+    assert payload["vs_baseline"] == pytest.approx(321.0 / 40.0, abs=1e-3)
+    assert payload["stale_from_run"]["value"] == 321.0
     assert payload["error"] == "tunnel wedged"
     assert payload["banked_from"]["value"] == 321.0
     assert payload["code_banked_from"]["value"] == 7.5
-    assert "banked_from only" in payload["note"]
+    assert "NOT a live measurement" in payload["note"]
 
 
 def test_fallback_json_without_any_bank(banked_repo):
@@ -143,8 +151,8 @@ def test_classify_probe_failure_taxonomy():
 
 
 def test_fallback_json_carries_failure_taxonomy(banked_repo):
-    """The taxonomy rides along in the fallback payload while the headline
-    stays the honest 0.0 + banked_from shape."""
+    """The taxonomy rides along in the fallback payload next to the
+    stale-carried headline and the banked session measurement."""
     _write_round(banked_repo, 6, [
         {"ok": True, "stage": "flatseed", "ts": 2,
          "result": {"evals_per_sec": 321.0}},
@@ -159,7 +167,8 @@ def test_fallback_json_carries_failure_taxonomy(banked_repo):
     ]
     payload = json.loads(bench._fallback_json("probe failed",
                                               failure_taxonomy=attempts))
-    assert payload["value"] == 0.0 and payload["vs_baseline"] == 0.0
+    assert payload["value"] == 321.0
+    assert payload["stale_from_run"]["value"] == 321.0
     assert payload["banked_from"]["value"] == 321.0
     assert payload["failure_taxonomy"]["kinds"] == {
         "timeout": 2, "init-failure": 1}

@@ -106,6 +106,16 @@ METRIC_KIND_REQUIRED: Dict[str, Tuple[str, ...]] = {
     # repo lint gate (cli lint --run-dir): the AST findings + jaxpr-pin
     # drift messages and the overall verdict
     "lint_report": ("paths", "findings", "pin_drift", "ok"),
+    # device-time attribution (fks_tpu.obs.profiler): one record per
+    # completed stage (wall/compile/compute split, occupancy) plus the
+    # stage="__total__" aggregate with the attributed fraction
+    "device_profile": ("stage", "wall_seconds"),
+    # cross-run history (cli trends): per-metric timeline + robust-z
+    # regression alerts over the bench-results archive
+    "trend_report": ("metric", "runs", "alerts"),
+    # serve-tier SLO pricing (fks_tpu.obs.history.slo_burn): one record
+    # per objective; burn_rate > 1 means the error budget is burning
+    "slo_burn": ("slo", "target", "observed", "burn_rate"),
 }
 
 #: an OpenMetrics sample line: name, optional {labels}, value, optional ts

@@ -21,6 +21,13 @@ Flat-step attribution variants (all under the bench configuration,
 Usage:  python tools/profile_step.py [--steps 4096] [--lanes 1,16,256] [--json]
 ``--json`` appends one machine-readable JSON line (consumed by the TPU
 session's profile256 stage). Results are summarized in PROFILE.md.
+
+Timing rides on the shared device-time attribution layer
+(fks_tpu.obs.profiler.profile_launch): each variant's cold call lands in
+a ``{name}:compile`` stage with its XLA backend-compile split read off
+the CompileWatcher, the measured call in ``{name}:steady`` — so the
+``--json`` payload carries the same ``device_profile`` record shape as
+bench.py and the evolve/serve pipelines.
 """
 from __future__ import annotations
 
@@ -29,21 +36,11 @@ import dataclasses
 import json
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
 import jax.numpy as jnp
-
-
-def timed(fn, *args):
-    out = fn(*args)
-    jax.block_until_ready(out)
-    t0 = time.perf_counter()
-    out = fn(*args)
-    jax.block_until_ready(out)
-    return time.perf_counter() - t0
 
 
 def main():
@@ -58,11 +55,13 @@ def main():
 
     from fks_tpu.data import TraceParser
     from fks_tpu.models import parametric, zoo
+    from fks_tpu.obs.profiler import StageProfiler, profile_launch
     from fks_tpu.ops.heap import (
         first_deletion_in_array_order, heap_pop, heap_push, KIND_DELETE)
     from fks_tpu.sim.engine import (
         SimConfig, build_step, initial_state, loop_tables)
 
+    prof = StageProfiler(scope="profile_step")
     dev = jax.devices()[0]
     print(f"device: {dev.platform} ({dev.device_kind}); steps={steps}",
           file=sys.stderr)
@@ -158,7 +157,9 @@ def main():
                 c0 = jax.tree_util.tree_map(
                     lambda x: jnp.broadcast_to(jnp.asarray(x),
                                                (lanes,) + jnp.shape(x)), carry)
-            secs = timed(fn, c0)
+            _, rec = profile_launch(fn, c0, name=f"{name}@l{lanes}",
+                                    profiler=prof)
+            secs = rec["best_seconds"]
             us = secs / steps * 1e6
             rows.append((lanes, name, us))
             print(f"lanes={lanes:4d} {name:12s} {us:9.2f} us/step "
@@ -191,6 +192,8 @@ def main():
                 "ctime_us": round(d["flat-ctime"] - d["flat-step"], 2),
                 "exact_full_us": round(d["full-step"], 2),
             }
+        # same attribution record shape as bench.py / cli report
+        payload["device_profile"] = prof.summary()
         print(json.dumps(payload), flush=True)
 
 

@@ -36,6 +36,14 @@ programs (exit 0). Pin drift means a key entry point compiles a
 different program than the one the evidence was gathered on — re-pin
 with ``cli lint --write-pins`` only when the change is intentional.
 Recorded as ``lint_gate``.
+
+A TRENDS GATE follows: ``cli trends`` over two synthetic bench-result
+histories written to a temp dir — a 10-run series with an injected 30%
+throughput drop must raise EXACTLY one alert (exit 1 under
+``--fail-on-alert``), and the same series without the drop must raise
+none (exit 0). A miss either way means the robust-z change-point pass
+is broken — its alerts on the real archive would be noise or silence.
+Recorded as ``trends_gate``. Pure-host (no jax import needed).
 """
 from __future__ import annotations
 
@@ -44,6 +52,7 @@ import os
 import re
 import subprocess
 import sys
+import tempfile
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -133,6 +142,48 @@ def lint_gate() -> dict:
     return {"ok": ok, **detail}
 
 
+def _write_history(root: str, values) -> None:
+    now = time.time()
+    for i, v in enumerate(values):
+        p = os.path.join(root, f"BENCH_r{i:02d}.json")
+        with open(p, "w") as f:
+            json.dump({"metric": "evals/s", "value": v, "unit": "evals/s",
+                       "vs_baseline": round(v / 40.0, 3)}, f)
+        ts = now - (len(values) - i) * 3600
+        os.utime(p, (ts, ts))
+
+
+def trends_gate() -> dict:
+    """Regression-flagging self-test: an injected 30% drop in a synthetic
+    10-run history must alert (rc 1 with --fail-on-alert, exactly one
+    alert); the clean series must not (rc 0). Returns {"ok": bool, ...}."""
+    clean = [100.0, 101.5, 99.2, 100.8, 98.9, 101.1, 99.7, 100.4, 99.9,
+             100.6]
+    regressed = clean[:7] + [70.0, 69.5, 70.3]
+    detail = {}
+    ok = True
+    with tempfile.TemporaryDirectory() as tmp:
+        for name, series, want_rc in (("clean", clean, 0),
+                                      ("regressed", regressed, 1)):
+            root = os.path.join(tmp, name)
+            os.makedirs(root)
+            _write_history(root, series)
+            proc = subprocess.run(
+                [sys.executable, "-m", "fks_tpu.cli", "trends", root,
+                 "--metric", "evals_per_sec", "--fail-on-alert"],
+                capture_output=True, text=True, cwd=REPO, timeout=300)
+            detail[f"{name}_rc"] = proc.returncode
+            if proc.returncode != want_rc:
+                ok = False
+                detail[f"{name}_err"] = (proc.stderr
+                                         or proc.stdout or "")[-500:]
+            if name == "regressed":
+                n = (proc.stdout or "").count("ALERT")
+                detail["alerts"] = n
+                ok = ok and n == 1
+    return {"ok": ok, **detail}
+
+
 def main() -> int:
     rev = subprocess.run(["git", "rev-parse", "--short", "HEAD"],
                          capture_output=True, text=True, cwd=REPO
@@ -152,6 +203,9 @@ def main() -> int:
     lgate = lint_gate()
     if not lgate["ok"]:
         print(f"LINT GATE FAILED: {lgate}", file=sys.stderr)
+    ngate = trends_gate()
+    if not ngate["ok"]:
+        print(f"TRENDS GATE FAILED: {ngate}", file=sys.stderr)
     t0 = time.time()
     proc = subprocess.run(
         [sys.executable, "-m", "pytest", "tests/", "-q",
@@ -163,12 +217,12 @@ def main() -> int:
     counts = {k: int(v) for v, k in re.findall(
         r"(\d+) (passed|failed|error|skipped|deselected|xfailed)", summary)}
     gates_ok = (gate["ok"] and tgate["ok"] and sgate["ok"] and vgate["ok"]
-                and lgate["ok"])
+                and lgate["ok"] and ngate["ok"])
     rc = proc.returncode if gates_ok else (proc.returncode or 1)
     row = {"ts": round(time.time(), 1), "rev": rev, "rc": rc,
            "wall_s": wall, **counts, "obs_gate": gate,
            "trace_gate": tgate, "scale_gate": sgate, "serve_gate": vgate,
-           "lint_gate": lgate, "summary": summary}
+           "lint_gate": lgate, "trends_gate": ngate, "summary": summary}
     os.makedirs(os.path.dirname(OUT), exist_ok=True)
     with open(OUT, "a") as f:
         f.write(json.dumps(row) + "\n")
