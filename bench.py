@@ -1239,6 +1239,105 @@ def stage_serve(gate: str = "") -> int:
     return rc
 
 
+def stage_promote(gate: str = "") -> int:
+    """CPU subprocess: promotion-pipeline headline (fks_tpu.pipeline) —
+    the evolve→serve hot-swap path. Stands up a live ServeService on a
+    seed champion, drops a better candidate into a fresh ledger, and
+    runs one PromotionController poll end to end, measuring:
+
+    - ``shadow_eval_seconds``: the full off-request-path cost of a
+      candidate (bucket-ladder build + warmup + replayed-traffic shadow
+      gates);
+    - ``promote_swap_ms``: the atomic engine flip itself;
+    - ``post_swap_recompiles``: backend compiles while serving live
+      traffic on the freshly promoted engine — gated at 0 (the swap
+      must inherit a fully warm ladder).
+    """
+    import tempfile
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    from fks_tpu.data.synthetic import synthetic_workload
+    from fks_tpu.funsearch import template
+    from fks_tpu.obs import CompileWatcher
+    from fks_tpu.pipeline import (
+        PromotionConfig, PromotionController, write_champion,
+    )
+    from fks_tpu.serve import (
+        ChampionSpec, ServeEngine, ServeService, ShapeEnvelope,
+    )
+
+    global _RECORDER
+    _RECORDER = _controller_recorder()
+    watcher = CompileWatcher().install()
+    nodes = int(os.environ.get("FKS_BENCH_PROMOTE_NODES", "16"))
+    envelope = ShapeEnvelope(max_pods=8, min_pod_bucket=8, max_batch=2)
+    wl = synthetic_workload(nodes, 16, seed=3)
+    incumbent = ServeEngine(
+        ChampionSpec(code=template.fill_template("score = 1000"),
+                     score=0.4, source="<bench-seed>"),
+        wl, envelope=envelope, engine="flat")
+    incumbent.warmup()
+    service = ServeService(incumbent, max_wait_s=0.002)
+    base = incumbent.base_pods
+
+    def traffic(n: int) -> None:
+        futs = [service.submit(
+            {"pods": [dict(base[(i + j) % len(base)]) for j in range(3)]})
+            for i in range(n)]
+        for f in futs:
+            f.result(timeout=300)
+
+    traffic(8)  # live traffic -> the replay buffer the shadow eval taps
+    tmp = tempfile.mkdtemp(prefix="fks_promote_")
+    candidate = ("score = 1000 + (node.cpu_milli_left - pod.cpu_milli)"
+                 " / max(1, node.cpu_milli_total)")
+    write_champion(tmp, template.fill_template(candidate), 0.9,
+                   name="bench")
+    ctrl = PromotionController(
+        service, wl, ledger_dir=tmp,
+        config=PromotionConfig(shadow_queries=4))
+    t0 = time.perf_counter()
+    verdict = ctrl.poll_once()
+    shadow_s = time.perf_counter() - t0
+    promoted = verdict.get("action") == "promoted"
+    marks = watcher.backend_compile_count
+    traffic(8)  # warm path on the promoted engine
+    recompiles = watcher.backend_compile_count - marks
+    service.close()
+    log(f"promote stage: {verdict.get('action')} in {shadow_s:.2f}s, "
+        f"swap {ctrl.last_swap_ms:.3f}ms, post-swap recompiles "
+        f"{recompiles}")
+
+    payload = {
+        "promote_swap_ms": ctrl.last_swap_ms,
+        "shadow_eval_seconds": round(shadow_s, 3),
+        "shadow_queries": int(ctrl.last_shadow.get("queries", 0)),
+        "shadow_p99_ms": float(ctrl.last_shadow.get("p99_ms", 0.0)),
+        "post_swap_recompiles": recompiles,
+        "promoted": int(promoted),
+        "backend_compiles": watcher.backend_compile_count,
+        "nodes": nodes, "engine": "flat",
+    }
+    _record("metric", "bench_stage", payload, stage="promote",
+            platform="cpu")
+    rc = 0
+    if not promoted:
+        log(f"FAIL: candidate not promoted: {verdict}")
+        rc = 1
+    if recompiles:
+        log(f"FAIL: {recompiles} recompiles after the swap — the shadow "
+            "ladder was not fully warm")
+        rc = 1
+    if gate:
+        rc = rc or _gate(gate, payload)
+    _record("finish", "ok" if rc == 0 else "fail")
+    _record("close")
+    print(json.dumps(payload))
+    return rc
+
+
 # ------------------------------------------------------------ controller
 
 
@@ -1341,6 +1440,10 @@ def main():
         # standalone champion-serving headline (cold vs warm latency,
         # batched qps, zero-recompile warm path); same --gate contract
         return stage_serve(gate)
+    if stage == "promote":
+        # standalone promotion-pipeline headline (shadow-eval cost, swap
+        # latency, zero post-swap recompiles); same --gate contract
+        return stage_promote(gate)
 
     # controller (hard deadline so the driver always gets the JSON line;
     # every stage/probe timeout below is clamped to the remaining budget)

@@ -398,6 +398,16 @@ def cmd_evolve(args):
               f"{fs.sentinel.max_drift:.3g}); see the run dir's alert "
               "events", file=sys.stderr)
         return 3
+    if getattr(fs, "llm_outage", False):
+        # distinct exit code: the run halted on the LLM-outage circuit
+        # breaker (llm_outage ledger event + checkpoint written), so a
+        # supervisor can tell "endpoint down, retry later" apart from a
+        # failed search
+        print(f"LLM OUTAGE: halted at generation {fs.generation} after "
+              f"{fs.cfg.llm_outage_generations} consecutive generations "
+              "with zero drafted candidates; checkpoint saved",
+              file=sys.stderr)
+        return 4
     return 0
 
 
@@ -566,10 +576,27 @@ def cmd_serve(args):
     from fks_tpu.serve.service import run_http, run_jsonl
 
     with _flight_recorder(args, "serve") as rec, obs.watch_compiles(rec):
+        import os as _os
+        from fks_tpu.serve.artifact import CHAMPION_DIR
+        ledger_dir = args.ledger_dir or CHAMPION_DIR
+        promotion_log = (args.promotion_log
+                         or _os.path.join(ledger_dir, "promotion.jsonl"))
         if args.artifact:
             engine = ServeEngine.load(args.artifact, recorder=rec)
         else:
-            champ_path = args.champion or latest_champion()
+            champ_path = args.champion
+            if not champ_path and args.follow_ledger:
+                # crash recovery: the promotion log outranks raw ledger
+                # order — restart with whatever the last surviving
+                # promotion shipped, not merely the best-scored file
+                from fks_tpu.pipeline import PromotionLog
+                active = PromotionLog(promotion_log).active()
+                if active and _os.path.exists(active.get("champion", "")):
+                    champ_path = active["champion"]
+                    print(f"resuming promoted champion: {champ_path}",
+                          file=sys.stderr)
+            if not champ_path:
+                champ_path = latest_champion(ledger_dir, recorder=rec)
             if not champ_path:
                 print("error: no champion JSON found — pass --champion or "
                       "evolve one first (policies/discovered/)",
@@ -621,6 +648,25 @@ def cmd_serve(args):
                                max_wait_s=args.max_wait_ms / 1e3,
                                audit_every=args.audit_every,
                                audit_tol=args.audit_tol, slo=slo)
+        stop_follow = None
+        if args.follow_ledger:
+            from fks_tpu.obs.history import SLOConfig as _SLO
+            from fks_tpu.pipeline import (
+                PromotionConfig, PromotionController, follow_ledger,
+            )
+            controller = PromotionController(
+                service, ledger_dir=ledger_dir, log_path=promotion_log,
+                config=PromotionConfig(slo=slo if slo is not None
+                                       else _SLO()),
+                recorder=rec)
+            # one synchronous poll before traffic (a champion newer than
+            # the one we loaded promotes up front, deterministically),
+            # then the background poll thread takes over
+            first = controller.poll_once()
+            if first.get("action") != "idle":
+                print(f"promotion: {first}", file=sys.stderr)
+            stop_follow, _ = follow_ledger(controller,
+                                           interval=args.promote_interval)
         try:
             if args.http:
                 print(f"listening on http://127.0.0.1:{args.http} "
@@ -634,10 +680,44 @@ def cmd_serve(args):
             else:
                 errors = run_jsonl(service)  # stdin
         finally:
+            if stop_follow is not None:
+                stop_follow.set()
             service.close()
             summary = service.summary()
             print(json.dumps(summary), file=sys.stderr)
     return 1 if errors else 0
+
+
+def cmd_pipeline(args):
+    """Promotion-pipeline utilities (fks_tpu.pipeline). Default: print
+    the promotion.jsonl state-machine status (per-attempt states, the
+    active promotion, interrupted attempts, torn lines). ``--drill``
+    runs the deterministic fault-injection drill matrix instead and
+    exits nonzero on any failed drill — the run_full_suite promotion
+    gate."""
+    import os
+
+    _apply_platform_flags(args)
+    from fks_tpu import obs
+    from fks_tpu.serve.artifact import CHAMPION_DIR
+
+    ledger_dir = args.ledger_dir or CHAMPION_DIR
+    log_path = args.log or os.path.join(ledger_dir, "promotion.jsonl")
+    if args.drill:
+        from fks_tpu.pipeline import run_drills
+
+        with _flight_recorder(args, "pipeline") as rec, \
+                obs.watch_compiles(rec):
+            results = run_drills(log=lambda m: print(m, file=sys.stderr))
+            ok = all(r["ok"] for r in results)
+            if rec.enabled:
+                rec.annotate_meta(drills=len(results), drills_ok=ok)
+        print(json.dumps({"ok": ok, "drills": results}, indent=2))
+        return 0 if ok else 1
+    from fks_tpu.pipeline import PromotionLog
+
+    print(json.dumps(PromotionLog(log_path).summary(), indent=2))
+    return 0
 
 
 def cmd_report(args):
@@ -1165,7 +1245,38 @@ def main(argv=None) -> int:
                     help="fraction of requests allowed over the p99 "
                          "target (default 0.01; burn_rate = observed "
                          "over-fraction / this budget)")
+    sv.add_argument("--follow-ledger", action="store_true",
+                    help="run the promotion controller alongside serving: "
+                         "tail the champion ledger, shadow-gate each new "
+                         "champion, hot-swap on promotion, auto-rollback "
+                         "on SLO burn (fks_tpu.pipeline)")
+    sv.add_argument("--ledger-dir", default="",
+                    help="champion ledger directory to follow (default: "
+                         "policies/discovered/)")
+    sv.add_argument("--promotion-log", default="",
+                    help="promotion.jsonl path (default: "
+                         "<ledger-dir>/promotion.jsonl)")
+    sv.add_argument("--promote-interval", type=float, default=5.0,
+                    help="seconds between ledger polls (default 5)")
     sv.set_defaults(fn=cmd_serve)
+
+    pp = sub.add_parser(
+        "pipeline", parents=[common],
+        help="promotion-pipeline status / fault-injection drills")
+    pp.add_argument("--ledger-dir", default="",
+                    help="champion ledger directory (default: "
+                         "policies/discovered/)")
+    pp.add_argument("--log", default="",
+                    help="promotion.jsonl path (default: "
+                         "<ledger-dir>/promotion.jsonl)")
+    pp.add_argument("--drill", action="store_true",
+                    help="run the deterministic fault-injection drill "
+                         "matrix (corrupt champion, device-eval error, "
+                         "p99 regression, kill -9 at every state, "
+                         "rollback-on-burn, zero-recompile swap, LLM "
+                         "outage) and exit nonzero on any failure — the "
+                         "run_full_suite promotion gate")
+    pp.set_defaults(fn=cmd_pipeline)
 
     r = sub.add_parser("report",
                        help="summarize a flight-recorder run directory")

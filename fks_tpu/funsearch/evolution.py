@@ -99,6 +99,11 @@ class EvolutionConfig:
     budget_eta: int = 2
     probe_suite: str = "smoke3"
     probe_steps: int = 0  # probe event budget; 0 = full trace on the probe
+    # LLM-outage circuit breaker: after this many CONSECUTIVE generations
+    # where every LLM call failed (zero candidates drafted), stop the run
+    # with an ``llm_outage`` ledger event instead of spinning through the
+    # remaining generation budget on an endpoint that is down (0 = spin)
+    llm_outage_generations: int = 3
 
     llm: LLMSettings = dataclasses.field(default_factory=LLMSettings)
 
@@ -129,6 +134,7 @@ class EvolutionConfig:
             budget_eta=fs.get("budget_eta", 2),
             probe_suite=fs.get("probe_suite", "smoke3"),
             probe_steps=fs.get("probe_steps", 0),
+            llm_outage_generations=fs.get("llm_outage_generations", 3),
             llm=LLMSettings(
                 api_key=lm.get("api_key", ""),
                 base_url=lm.get("base_url", LLMSettings.base_url),
@@ -278,6 +284,12 @@ class FunSearch:
         self.generation = 0
         self.best: Optional[Member] = None
         self.history: List[GenerationStats] = []
+        # LLM-outage circuit breaker: consecutive all-calls-failed
+        # generations; run_evolution() trips after
+        # cfg.llm_outage_generations of them and sets ``llm_outage``
+        # (the CLI maps it to a distinct exit code)
+        self.llm_failures = 0
+        self.llm_outage = False
         # lazily built device-resident parametric searcher; its weight
         # population persists on device across generations (its state is
         # NOT checkpointed — rendered champions persist via the code
@@ -488,6 +500,13 @@ class FunSearch:
                     self.generator, n_new, self._sample_parents, feedback,
                     cfg.max_workers)
         llm_s = lt.seconds
+        # outage tracking: a generation that ASKED for candidates and got
+        # none back means every LLM call failed (generate() returns None
+        # on any failure and generate_many drops them)
+        if n_new > 0 and not codes:
+            self.llm_failures += 1
+        else:
+            self.llm_failures = 0
 
         # plain wall time: evaluate() returns host floats (each candidate's
         # score is materialized inside), so there is nothing left to sync —
@@ -655,6 +674,21 @@ class FunSearch:
                 self.log(f"early stop: {stats.best_score:.4f} >= "
                          f"{self.cfg.early_stop_threshold}")
                 break
+            if (self.cfg.llm_outage_generations > 0
+                    and self.llm_failures >= self.cfg.llm_outage_generations):
+                # the endpoint is down, not flaky: stop burning the
+                # generation budget on empty rounds. The caller's normal
+                # shutdown path still checkpoints and saves champions.
+                self.llm_outage = True
+                self.recorder.event(
+                    "llm_outage", generation=self.generation,
+                    consecutive=self.llm_failures,
+                    detail=f"every LLM call failed for {self.llm_failures} "
+                           "consecutive generations; halting evolution")
+                self.log(f"LLM OUTAGE: {self.llm_failures} consecutive "
+                         "generations with zero drafted candidates; "
+                         "checkpointing and stopping")
+                break
         if self.best is None:
             return "", 0.0
         return self.best
@@ -757,11 +791,28 @@ class FunSearch:
             json.dump(state, f)
         os.replace(tmp, path)
 
+    #: config fields that change what a fitness NUMBER means (or how the
+    #: population evolves); resuming a checkpoint across a drift in any
+    #: of them would silently mix incomparable scores in one population
+    _DRIFT_KEYS = ("scenario_suite", "robust_aggregation",
+                   "robust_cvar_alpha", "population_size")
+
     def restore(self, path: str) -> None:
         with open(path) as f:
             state = json.load(f)
         if state.get("version") != 1:
             raise ValueError(f"unknown checkpoint version {state.get('version')}")
+        stored = state.get("config") or {}
+        current = dataclasses.asdict(self.cfg)
+        drifted = [k for k in self._DRIFT_KEYS
+                   if k in stored and stored[k] != current[k]]
+        if drifted:
+            diff = ", ".join(f"{k}: checkpoint={stored[k]!r} "
+                             f"current={current[k]!r}" for k in drifted)
+            raise ValueError(
+                f"{path}: checkpoint config drift — resuming would mix "
+                f"incomparable fitness scales ({diff}). Re-run with the "
+                "checkpoint's config or start a fresh checkpoint.")
         self.generation = state["generation"]
         self.population = [(m["code"], m["score"]) for m in state["population"]]
         self.best = ((state["best"]["code"], state["best"]["score"])

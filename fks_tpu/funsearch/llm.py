@@ -27,6 +27,30 @@ from fks_tpu.funsearch import sandbox, template, transpiler
 Parent = Tuple[str, float]  # (candidate source, fitness)
 
 
+def _retry_after_seconds(headers) -> Optional[float]:
+    """Parse a ``Retry-After`` response header: either delta-seconds or
+    an HTTP-date (RFC 9110 §10.2.3). None when absent or unparsable —
+    the caller falls back to its own backoff ladder."""
+    value = headers.get("Retry-After") if headers is not None else None
+    if not value:
+        return None
+    value = value.strip()
+    try:
+        return max(0.0, float(value))
+    except ValueError:
+        pass
+    import email.utils  # noqa: PLC0415 — keep module imports jax-light
+    import time
+
+    try:
+        when = email.utils.parsedate_to_datetime(value)
+    except (TypeError, ValueError):
+        return None
+    if when is None:
+        return None
+    return max(0.0, when.timestamp() - time.time())
+
+
 class TextBackend(Protocol):
     """Something that turns a prompt into a raw logic block."""
 
@@ -93,6 +117,7 @@ class OpenAIBackend:
         last: Exception = TimeoutError(
             f"deadline ({self.deadline:g}s) exhausted before any attempt")
         t_end = time.monotonic() + self.deadline
+        retry_after: Optional[float] = None
         for attempt in range(self.max_retries + 1):
             remaining = t_end - time.monotonic()
             if remaining <= 0:
@@ -122,11 +147,20 @@ class OpenAIBackend:
                 last = e
                 if e.code not in (429, 500, 502, 503, 504):
                     raise
+                # rate-limit / overload responses usually say when to come
+                # back; honoring it beats hammering a throttling endpoint
+                # with the fixed-ladder backoff
+                if e.code in (429, 503):
+                    retry_after = _retry_after_seconds(e.headers)
             except (urllib.error.URLError, TimeoutError, OSError) as e:
                 last = e
             if attempt < self.max_retries:
-                time.sleep(min(0.5 * (attempt + 1),
-                               max(0.0, t_end - time.monotonic())))
+                delay = (retry_after if retry_after is not None
+                         else 0.5 * (attempt + 1))
+                # always capped by the overall deadline: a server asking
+                # for an hour gets whatever budget is actually left
+                time.sleep(min(delay, max(0.0, t_end - time.monotonic())))
+            retry_after = None
         raise last
 
 

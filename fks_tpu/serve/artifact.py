@@ -84,28 +84,55 @@ class ChampionSpec:
 def load_champion(path: str) -> ChampionSpec:
     """Load a champion from an evolution-ledger JSON: either a single
     champion dict (``save_best_policy``) or a top-policies list
-    (``save_top_policies`` — the best-scoring entry wins)."""
-    with open(path) as f:
-        doc = json.load(f)
+    (``save_top_policies`` — the best-scoring entry wins). Validates the
+    fields an engine build would otherwise trip over later: ``code`` must
+    be a non-empty string and ``score`` a finite number — a torn or
+    hand-mangled ledger file fails HERE, with the path in the message,
+    not deep inside the transpiler."""
+    import math
+
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except json.JSONDecodeError as e:
+        raise ValueError(f"{path}: not valid JSON "
+                         f"(truncated mid-write?): {e}") from e
     if isinstance(doc, list):
         if not doc:
             raise ValueError(f"{path}: empty top-policies list")
         doc = max(doc, key=lambda d: float(d.get("score", 0.0)))
-    if "code" not in doc:
-        raise ValueError(f"{path}: no 'code' field — not a champion JSON")
+    if not isinstance(doc, dict):
+        raise ValueError(f"{path}: champion JSON must be a dict or list, "
+                         f"got {type(doc).__name__}")
+    code = doc.get("code")
+    if not isinstance(code, str) or not code.strip():
+        raise ValueError(f"{path}: no usable 'code' field — "
+                         "not a champion JSON")
+    try:
+        score = float(doc.get("score", 0.0))
+    except (TypeError, ValueError) as e:
+        raise ValueError(f"{path}: non-numeric 'score' "
+                         f"{doc.get('score')!r}") from e
+    if not math.isfinite(score):
+        raise ValueError(f"{path}: non-finite 'score' {score!r}")
     return ChampionSpec.from_json(doc, source=path)
 
 
-def latest_champion(directory: str = "") -> Optional[str]:
+def latest_champion(directory: str = "", recorder=None) -> Optional[str]:
     """Path of the best champion JSON under ``directory`` (default: the
     repo's discovered-policies ledger), by score then filename; None when
-    the ledger is empty."""
+    the ledger is empty. A malformed file — typically the newest one,
+    torn by a crash mid-write — is skipped with a recorded ``alert``
+    event instead of hiding the whole ledger or raising."""
     directory = directory or CHAMPION_DIR
+    rec = recorder if recorder is not None else obs.get_recorder()
     best: Optional[Tuple[float, str]] = None
     for path in sorted(glob.glob(os.path.join(directory, "*.json"))):
         try:
             spec = load_champion(path)
-        except (ValueError, KeyError, OSError, json.JSONDecodeError):
+        except (ValueError, KeyError, OSError) as e:
+            rec.event("alert", source="champion_ledger", path=path,
+                      detail=f"skipping unreadable champion: {e}")
             continue  # one malformed file must not hide the ledger
         if best is None or spec.score > best[0]:
             best = (spec.score, path)

@@ -18,6 +18,7 @@ from __future__ import annotations
 import json
 import sys
 import time
+from collections import deque
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -42,7 +43,8 @@ class ServeService:
     def __init__(self, engine: ServeEngine, *, recorder=None,
                  max_batch: Optional[int] = None, max_wait_s: float = 0.005,
                  audit_every: int = 0, audit_tol: float = 1e-5,
-                 slo: Optional[SLOConfig] = None, slo_every: int = 100):
+                 slo: Optional[SLOConfig] = None, slo_every: int = 100,
+                 replay_buffer: int = 64):
         self.engine = engine
         self.recorder = recorder if recorder is not None else obs.get_recorder()
         self.audit_every = int(audit_every)
@@ -65,6 +67,41 @@ class ServeService:
         self._t_last: float = 0.0
         self.audits = 0
         self.audit_failures = 0
+        # last-N answered pod lists: the shadow-eval replay source for
+        # the promotion pipeline (a candidate is judged on the traffic
+        # the incumbent actually saw, not a synthetic guess)
+        self._replay: deque = deque(maxlen=max(1, int(replay_buffer)))
+        self.swaps = 0
+
+    # ----- engine hot-swap + replay (fks_tpu.pipeline)
+
+    def swap_engine(self, new_engine: ServeEngine) -> ServeEngine:
+        """Atomically flip the serving engine; returns the old one (the
+        rollback handle). A single attribute assignment is the entire
+        swap — ``_handle_batch`` reads ``self.engine`` once per batch, so
+        an in-flight batch finishes on the old engine and the next batch
+        lands on the new one; nothing is ever half-swapped. Safe only if
+        ``new_engine`` is already warm (the promotion controller builds
+        and warms the bucket ladder off the request path)."""
+        old = self.engine
+        self.engine = new_engine
+        self.swaps += 1
+        return old
+
+    def recent_queries(self, n: int) -> List[List[dict]]:
+        """The last ``n`` answered pod lists, oldest first — shadow-eval
+        replay traffic."""
+        items = list(self._replay)
+        return [list(q) for q in items[-max(0, int(n)):]]
+
+    @property
+    def requests_served(self) -> int:
+        return len(self._latencies_ms)
+
+    def latencies_since(self, mark: int) -> List[float]:
+        """Per-request latencies recorded after request index ``mark`` —
+        the probation window the rollback gate prices."""
+        return list(self._latencies_ms[max(0, int(mark)):])
 
     # ----- query resolution
 
@@ -109,7 +146,11 @@ class ServeService:
 
     def _handle_batch(self, items: List[Tuple[str, List[dict]]],
                       enq_times: List[float]) -> List[dict]:
-        answers = self.engine.answer_batch([pods for _, pods in items])
+        # pin the engine once per batch: the promotion controller may
+        # swap ``self.engine`` concurrently, and a batch must be answered
+        # (and audited) by ONE engine end to end
+        engine = self.engine
+        answers = engine.answer_batch([pods for _, pods in items])
         done = time.perf_counter()
         if self._t_first is None:
             self._t_first = min(enq_times)
@@ -119,6 +160,7 @@ class ServeService:
             latency_ms = (done - enq) * 1e3
             ans["id"] = rid
             ans["latency_ms"] = round(latency_ms, 3)
+            self._replay.append(pods)
             self._latencies_ms.append(latency_ms)
             self.recorder.metric(
                 "serve_request", request_id=rid,
@@ -128,7 +170,7 @@ class ServeService:
                 bucket_lanes=ans["bucket_lanes"])
             if self.audit_every > 0 and \
                     len(self._latencies_ms) % self.audit_every == 0:
-                self._audit(rid, pods, ans)
+                self._audit(engine, rid, pods, ans)
         if (self.slo.enabled
                 and len(self._latencies_ms) // self.slo_every
                 > self._slo_marks):
@@ -137,8 +179,9 @@ class ServeService:
                             self._elapsed(), recorder=self.recorder)
         return answers
 
-    def _audit(self, rid: str, pods: List[dict], ans: dict) -> None:
-        ref = self.engine.reference_answer(pods)
+    def _audit(self, engine: ServeEngine, rid: str, pods: List[dict],
+               ans: dict) -> None:
+        ref = engine.reference_answer(pods)
         ok = self.sentinel.audit_served(
             rid, ans["score"], ref["score"],
             placements_match=ans["placements"] == ref["placements"])
@@ -167,6 +210,7 @@ class ServeService:
             "cold_compiles": self.engine.cold_compiles,
             "audits": self.audits,
             "audit_failures": self.audit_failures,
+            "swaps": self.swaps,
         }
         if self.slo.enabled:
             out["slo"] = record_slo_burn(

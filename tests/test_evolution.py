@@ -298,3 +298,102 @@ def test_rescore_fallback_counter(evaluator, monkeypatch):
                           0.42)
     assert got == 0.42  # falls back to the search fitness
     assert fs.rescore_fallbacks == before + 1
+
+
+def test_restore_rejects_config_drift(evaluator, tmp_path):
+    """Resuming a checkpoint under a different suite/aggregation/population
+    would mix incomparable fitness scales — restore must fail loudly,
+    naming the drifted keys."""
+    import dataclasses
+
+    ck = str(tmp_path / "evo.json")
+    fs = make_fs(evaluator)
+    fs.initialize_population()
+    fs.checkpoint(ck)
+
+    for key, value in (("population_size", 16),
+                       ("scenario_suite", "default8"),
+                       ("robust_aggregation", "cvar")):
+        cfg2 = dataclasses.replace(fs.cfg, **{key: value})
+        fs2 = FunSearch(evaluator, cfg2, backend=FakeLLM(seed=7), log=quiet)
+        with pytest.raises(ValueError, match=key):
+            fs2.restore(ck)
+    # the matching config still restores
+    fs3 = make_fs(evaluator)
+    fs3.restore(ck)
+    assert fs3.generation == fs.generation
+
+
+def test_restore_tolerates_checkpoint_without_config(evaluator, tmp_path):
+    """Pre-drift-check checkpoints carry no config block; they must keep
+    restoring (drift detection is best-effort on old files)."""
+    ck = tmp_path / "evo.json"
+    fs = make_fs(evaluator)
+    fs.initialize_population()
+    fs.checkpoint(str(ck))
+    state = json.loads(ck.read_text())
+    del state["config"]
+    ck.write_text(json.dumps(state))
+    fs2 = make_fs(evaluator)
+    fs2.restore(str(ck))
+    assert fs2.generation == fs.generation
+
+
+def test_llm_outage_circuit_breaker(tmp_path):
+    """A total LLM outage (every call raises) halts the loop after N
+    consecutive empty generations with the llm_outage flag up, a ledger
+    event recorded, and the checkpoint still written by run()."""
+    import os
+
+    class DeadBackend:
+        calls = 0
+
+        def complete(self, prompt):
+            DeadBackend.calls += 1
+            raise RuntimeError("endpoint down")
+
+    class EventRec:
+        def __init__(self):
+            self.events = []
+
+        def event(self, kind, **fields):
+            self.events.append({"kind": kind, **fields})
+
+        def metric(self, kind, record=None, **fields):
+            pass
+
+        def heartbeat(self):
+            pass
+
+    rec = EventRec()
+    ck = str(tmp_path / "evo.json")
+    cfg = EvolutionConfig(population_size=6, generations=6, elite_size=2,
+                          candidates_per_generation=3, max_workers=1,
+                          seed=3, early_stop_threshold=1.1,
+                          llm_outage_generations=2)
+    fs = evo.run(micro_workload(), cfg, backend=DeadBackend(),
+                 checkpoint_path=ck, out_dir=str(tmp_path / "out"),
+                 recorder=rec, log=quiet)
+    assert fs.llm_outage
+    assert fs.generation == 2  # halted, not the 6-generation budget
+    assert fs.best is not None  # seeds still scored
+    assert os.path.exists(ck)  # the shutdown path checkpointed first
+    assert DeadBackend.calls > 0
+    outage = [e for e in rec.events if e["kind"] == "llm_outage"]
+    assert outage and outage[0]["consecutive"] == 2
+
+
+def test_llm_failures_reset_on_success(evaluator):
+    """A flaky endpoint (one empty generation, then drafts) must NOT trip
+    the breaker: the consecutive-failure counter resets."""
+    fs = make_fs(evaluator, llm_outage_generations=2)
+    fs.initialize_population()
+    real_complete = fs.generator.backend.complete
+    fs.generator.backend.complete = lambda prompt: (_ for _ in ()).throw(
+        RuntimeError("down"))
+    fs.evolve_generation()
+    assert fs.llm_failures == 1
+    fs.generator.backend.complete = real_complete
+    fs.evolve_generation()
+    assert fs.llm_failures == 0
+    assert not fs.llm_outage

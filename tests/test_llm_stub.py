@@ -65,6 +65,20 @@ class StubHandler(BaseHTTPRequestHandler):
             self.end_headers()
             self.wfile.write(b"upstream error")
             return
+        elif mode == "retry_after":  # one throttle naming its delay, then ok
+            if len(self.server.requests) == 1:
+                self.send_response(429)
+                self.send_header("Retry-After", "1")
+                self.end_headers()
+                self.wfile.write(b"throttled")
+                return
+            content = GOOD_LOGIC
+        elif mode == "retry_after_always":  # throttled forever, huge delay
+            self.send_response(429)
+            self.send_header("Retry-After", "3600")
+            self.end_headers()
+            self.wfile.write(b"throttled")
+            return
         elif mode == "hang":
             time.sleep(10)  # far beyond the client timeout
             self.send_response(200)
@@ -156,6 +170,49 @@ def test_transient_error_is_retried(stub_server):
     backend = _backend(stub_server, max_retries=1)
     assert backend.complete("p") == GOOD_LOGIC
     assert len(stub_server.requests) == 2
+
+
+def test_retry_after_header_honored(stub_server):
+    """A 429 naming its delay is respected: the retry waits ~Retry-After
+    instead of the 0.5s backoff ladder, then succeeds."""
+    stub_server.mode = "retry_after"
+    backend = _backend(stub_server, max_retries=1)
+    t0 = time.monotonic()
+    assert backend.complete("p") == GOOD_LOGIC
+    elapsed = time.monotonic() - t0
+    assert len(stub_server.requests) == 2
+    assert elapsed >= 0.9  # waited the server-named second, not 0.5s
+
+
+def test_retry_after_capped_by_deadline(stub_server):
+    """A server demanding an hour between retries gets only the deadline:
+    complete() fails within the configured budget, not in 3600s."""
+    stub_server.mode = "retry_after_always"
+    backend = _backend(stub_server, max_retries=2, deadline=2.0)
+    t0 = time.monotonic()
+    with pytest.raises(Exception):
+        backend.complete("p")
+    assert time.monotonic() - t0 < 8  # bounded by deadline, not Retry-After
+
+
+def test_retry_after_parsing():
+    """Header parsing: delta-seconds, HTTP-date, absent, garbage."""
+    from email.utils import formatdate
+
+    from fks_tpu.funsearch.llm import _retry_after_seconds
+
+    assert _retry_after_seconds({"Retry-After": "7"}) == 7.0
+    assert _retry_after_seconds({"Retry-After": "0"}) == 0.0
+    http_date = _retry_after_seconds(
+        {"Retry-After": formatdate(time.time() + 30, usegmt=True)})
+    assert http_date is not None and 20 <= http_date <= 31
+    # a date in the past clamps to "retry now", never negative
+    past = _retry_after_seconds(
+        {"Retry-After": formatdate(time.time() - 60, usegmt=True)})
+    assert past == 0.0
+    assert _retry_after_seconds({}) is None
+    assert _retry_after_seconds(None) is None
+    assert _retry_after_seconds({"Retry-After": "soonish"}) is None
 
 
 def test_timeout_yields_none(stub_server):

@@ -65,6 +65,12 @@ EVENT_KIND_REQUIRED: Dict[str, Tuple[str, ...]] = {
     # candidate rejected before the sandbox/transpile/compile pipeline —
     # the taxonomy label is machine-readable and closed-vocabulary
     "candidate_rejected": ("taxonomy", "stage"),
+    # promotion pipeline (fks_tpu.pipeline): a post-promotion SLO burn
+    # swapped the last-good engine back
+    "rollback": ("attempt", "reason"),
+    # evolve circuit breaker: N consecutive all-failed-LLM generations
+    # tripped the loop (cli evolve exits 4 after checkpointing)
+    "llm_outage": ("generation", "consecutive"),
 }
 
 #: legal ``taxonomy`` values on a candidate_rejected event. This tool is
@@ -116,6 +122,10 @@ METRIC_KIND_REQUIRED: Dict[str, Tuple[str, ...]] = {
     # serve-tier SLO pricing (fks_tpu.obs.history.slo_burn): one record
     # per objective; burn_rate > 1 means the error budget is burning
     "slo_burn": ("slo", "target", "observed", "burn_rate"),
+    # promotion pipeline (fks_tpu.pipeline.state): one record per
+    # state-machine transition in promotion.jsonl, mirrored to the
+    # flight recorder so a run dir tells the whole promotion story
+    "promotion_event": ("attempt", "state", "champion"),
 }
 
 #: an OpenMetrics sample line: name, optional {labels}, value, optional ts

@@ -142,6 +142,23 @@ def lint_gate() -> dict:
     return {"ok": ok, **detail}
 
 
+def promote_gate() -> dict:
+    """Promotion-drill matrix: every deterministic fault-injection drill
+    (corrupt champion, device-eval error, p99 regression, kill -9 per
+    state, rollback on burn, zero-recompile swap, llm outage) must pass
+    — ``cli pipeline --drill`` exits 0. Returns {"ok": bool, ...}."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "-m", "fks_tpu.cli", "pipeline", "--cpu",
+         "--drill"],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=900)
+    ok = proc.returncode == 0
+    detail = {"rc": proc.returncode}
+    if not ok:
+        detail["err"] = (proc.stderr or proc.stdout or "")[-500:]
+    return {"ok": ok, **detail}
+
+
 def _write_history(root: str, values) -> None:
     now = time.time()
     for i, v in enumerate(values):
@@ -206,6 +223,9 @@ def main() -> int:
     ngate = trends_gate()
     if not ngate["ok"]:
         print(f"TRENDS GATE FAILED: {ngate}", file=sys.stderr)
+    pgate = promote_gate()
+    if not pgate["ok"]:
+        print(f"PROMOTE GATE FAILED: {pgate}", file=sys.stderr)
     t0 = time.time()
     proc = subprocess.run(
         [sys.executable, "-m", "pytest", "tests/", "-q",
@@ -217,12 +237,13 @@ def main() -> int:
     counts = {k: int(v) for v, k in re.findall(
         r"(\d+) (passed|failed|error|skipped|deselected|xfailed)", summary)}
     gates_ok = (gate["ok"] and tgate["ok"] and sgate["ok"] and vgate["ok"]
-                and lgate["ok"] and ngate["ok"])
+                and lgate["ok"] and ngate["ok"] and pgate["ok"])
     rc = proc.returncode if gates_ok else (proc.returncode or 1)
     row = {"ts": round(time.time(), 1), "rev": rev, "rc": rc,
            "wall_s": wall, **counts, "obs_gate": gate,
            "trace_gate": tgate, "scale_gate": sgate, "serve_gate": vgate,
-           "lint_gate": lgate, "trends_gate": ngate, "summary": summary}
+           "lint_gate": lgate, "trends_gate": ngate,
+           "promote_gate": pgate, "summary": summary}
     os.makedirs(os.path.dirname(OUT), exist_ok=True)
     with open(OUT, "a") as f:
         f.write(json.dumps(row) + "\n")
