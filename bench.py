@@ -1338,6 +1338,139 @@ def stage_promote(gate: str = "") -> int:
     return rc
 
 
+def stage_resilience(gate: str = "") -> int:
+    """CPU subprocess: resilience-layer headline (fks_tpu.resilience) —
+    the cost of staying up under overload and device loss. Measures:
+
+    - ``shed_submit_us``: how fast a bounded-queue overflow submit is
+      refused with a typed ``ShedError`` (load shedding must be far
+      cheaper than serving — a slow rejection path IS an outage);
+    - ``degrade_flip_ms``: wall time from the faulting request to its
+      answer served on the exact-CPU fallback (fault classification +
+      atomic ``swap_engine`` + same-batch retry, all on one request);
+    - ``drain_ms``: SIGTERM-path drain of a service with queued tail
+      traffic — every Future completed, replay buffer persisted.
+
+    Gated invariants ride along: exactly one engine flip, 0.0 parity
+    drift on the fallback answers, drain not stuck.
+    """
+    import tempfile
+    import threading
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    from fks_tpu.data.synthetic import synthetic_workload
+    from fks_tpu.funsearch import template
+    from fks_tpu.pipeline.faults import FlakyEngineProxy
+    from fks_tpu.resilience import DegradeConfig, DrainCoordinator, ShedError
+    from fks_tpu.serve import (
+        ChampionSpec, ServeEngine, ServeService, ShapeEnvelope,
+    )
+    from fks_tpu.serve.batcher import RequestBatcher
+
+    global _RECORDER
+    _RECORDER = _controller_recorder()
+    import dataclasses as _dc
+
+    envelope = ShapeEnvelope(max_pods=8, min_pod_bucket=8, max_batch=2)
+    wl = synthetic_workload(16, 16, seed=3)
+    champion = ChampionSpec(code=template.fill_template("score = 1000"),
+                            score=0.4, source="<bench-seed>")
+    incumbent = ServeEngine(champion, wl, envelope=envelope, engine="flat")
+    incumbent.warmup()
+    fallback = ServeEngine(champion, wl,
+                           envelope=_dc.replace(envelope, max_batch=1),
+                           engine="exact")
+    fallback.warmup()
+
+    # -- shed latency: bounded batcher, worker provably parked in a
+    # batch, queue full; each overflow submit must raise ShedError.
+    blocked, entered = threading.Event(), threading.Event()
+
+    def parked(queries, enq):
+        entered.set()
+        blocked.wait(60)
+        return list(queries)
+
+    b = RequestBatcher(parked, max_batch=1, max_wait_s=0.0, max_queue=2)
+    shed_us = 0.0
+    try:
+        held = [b.submit("a")]
+        entered.wait(30)
+        held += [b.submit("b"), b.submit("c")]  # fills the queue
+        reps = 200
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            try:
+                b.submit("overflow")
+            except ShedError:
+                pass
+        shed_us = (time.perf_counter() - t0) / reps * 1e6
+        blocked.set()
+        for f in held:
+            f.result(30)
+    finally:
+        blocked.set()
+        b.close()
+
+    # -- degrade flip: one faulting request, answered on the fallback.
+    flaky = FlakyEngineProxy(incumbent, failures=1)
+    service = ServeService(flaky, max_wait_s=0.002)
+    service.enable_degraded_mode(
+        lambda: fallback, config=DegradeConfig(background_rebuild=False))
+    base = incumbent.base_pods
+    pods = [dict(base[j % len(base)]) for j in range(3)]
+    t0 = time.perf_counter()
+    ans = service.submit({"pods": [dict(p) for p in pods]}).result(300)
+    flip_ms = (time.perf_counter() - t0) * 1e3
+    drift = abs(ans["score"] - incumbent.reference_answer(pods)["score"])
+    flips = service.degrade.healthz()["flips"]
+
+    # -- drain: queued tail traffic, SIGTERM-path drain + persist.
+    tail = [service.submit(
+        {"pods": [dict(base[(i + j) % len(base)]) for j in range(3)]})
+        for i in range(4)]
+    tmp = tempfile.mkdtemp(prefix="fks_bench_res_")
+    dc = DrainCoordinator(service, state_path=os.path.join(
+        tmp, "serve_state.json"), grace_s=60.0)
+    t0 = time.perf_counter()
+    report = dc.drain()
+    drain_ms = (time.perf_counter() - t0) * 1e3
+    pending_after = sum(1 for f in tail if not f.done())
+
+    log(f"resilience stage: shed {shed_us:.1f}us, flip {flip_ms:.1f}ms "
+        f"(drift {drift}), drain {drain_ms:.1f}ms "
+        f"({report.get('completed')} completed)")
+    payload = {
+        "shed_submit_us": round(shed_us, 1),
+        "degrade_flip_ms": round(flip_ms, 2),
+        "drain_ms": round(drain_ms, 2),
+        "degrade_flips": flips,
+        "degrade_parity_drift": drift,
+        "drain_completed": report.get("completed"),
+        "drain_stuck": bool(report.get("stuck")),
+        "engine": "flat",
+    }
+    _record("metric", "bench_stage", payload, stage="resilience",
+            platform="cpu")
+    rc = 0
+    if flips != 1 or drift != 0.0:
+        log(f"FAIL: degrade flip invariants (flips={flips}, "
+            f"drift={drift})")
+        rc = 1
+    if report.get("stuck") or pending_after:
+        log(f"FAIL: drain left {pending_after} pending futures "
+            f"(stuck={report.get('stuck')})")
+        rc = 1
+    if gate:
+        rc = rc or _gate(gate, payload)
+    _record("finish", "ok" if rc == 0 else "fail")
+    _record("close")
+    print(json.dumps(payload))
+    return rc
+
+
 # ------------------------------------------------------------ controller
 
 
@@ -1444,6 +1577,11 @@ def main():
         # standalone promotion-pipeline headline (shadow-eval cost, swap
         # latency, zero post-swap recompiles); same --gate contract
         return stage_promote(gate)
+    if stage == "resilience":
+        # standalone resilience headline (shed latency, degrade-flip
+        # time, drain time, parity-drift invariants); same --gate
+        # contract
+        return stage_resilience(gate)
 
     # controller (hard deadline so the driver always gets the JSON line;
     # every stage/probe timeout below is clamped to the remaining budget)

@@ -323,6 +323,10 @@ def cmd_evolve(args):
         cfg.probe_suite = args.probe_suite
     if args.probe_steps is not None:
         cfg.probe_steps = args.probe_steps
+    if args.wal and not args.checkpoint:
+        print("note: --wal without --checkpoint only protects the first "
+              "generation; pass --checkpoint so every generation boundary "
+              "is durable", file=sys.stderr)
     backend = FakeLLM(seed=cfg.seed) if args.fake_llm else None
     if backend is None and not cfg.llm.api_key:
         print("no API key in config; use --fake-llm for hermetic runs",
@@ -372,7 +376,8 @@ def cmd_evolve(args):
                 metrics.write("generation", dataclasses.asdict(st))
         fs = evo.run(wl, cfg, backend=backend,
                      sim_config=SimConfig(watchdog=args.watchdog),
-                     checkpoint_path=args.checkpoint, out_dir=args.out,
+                     checkpoint_path=args.checkpoint,
+                     wal_path=args.wal, out_dir=args.out,
                      engine=args.engine, on_generation=on_gen,
                      profile=args.profile)
         if fs.best:
@@ -647,7 +652,41 @@ def cmd_serve(args):
         service = ServeService(engine, recorder=rec,
                                max_wait_s=args.max_wait_ms / 1e3,
                                audit_every=args.audit_every,
-                               audit_tol=args.audit_tol, slo=slo)
+                               audit_tol=args.audit_tol, slo=slo,
+                               max_queue=args.max_queue,
+                               default_deadline_s=args.request_deadline_s)
+        if args.degraded_fallback:
+            from fks_tpu.resilience import exact_fallback_factory
+
+            # fallback + rebuild reuse the engine's own champion/workload;
+            # the rebuild recreates the primary configuration warm
+            service.enable_degraded_mode(
+                exact_fallback_factory(engine.champion, _parse_workload(
+                    args)[1], engine.envelope, recorder=rec),
+                rebuild_factory=None)
+            print("degraded-mode fallback armed (exact engine, batch 1)",
+                  file=sys.stderr)
+        drainer = None
+        if args.drain_state:
+            from fks_tpu.resilience import (DrainCoordinator,
+                                            load_serve_state)
+
+            if _os.path.exists(args.drain_state):
+                try:
+                    n = service.preload_replay(
+                        load_serve_state(args.drain_state)["replay"])
+                    print(f"replay buffer preloaded: {n} queries from "
+                          f"{args.drain_state}", file=sys.stderr)
+                except ValueError as e:
+                    print(f"ignoring stale drain state: {e}",
+                          file=sys.stderr)
+            drainer = DrainCoordinator(service,
+                                       state_path=args.drain_state,
+                                       recorder=rec)
+            if not drainer.install():
+                print("warning: SIGTERM handler unavailable off the main "
+                      "thread; drain runs on normal shutdown only",
+                      file=sys.stderr)
         stop_follow = None
         if args.follow_ledger:
             from fks_tpu.obs.history import SLOConfig as _SLO
@@ -672,7 +711,9 @@ def cmd_serve(args):
                 print(f"listening on http://127.0.0.1:{args.http} "
                       "(POST /query, GET /stats, GET /healthz)",
                       file=sys.stderr)
-                run_http(service, args.http)
+                run_http(service, args.http,
+                         deadline_s=args.request_deadline_s,
+                         drain_coordinator=drainer)
                 errors = 0
             elif args.queries and args.queries != "-":
                 with open(args.queries) as f:
@@ -682,6 +723,10 @@ def cmd_serve(args):
         finally:
             if stop_follow is not None:
                 stop_follow.set()
+            if drainer is not None and drainer.report is None:
+                # normal shutdown still drains + persists (idempotent
+                # with the SIGTERM path)
+                drainer.drain()
             service.close()
             summary = service.summary()
             print(json.dumps(summary), file=sys.stderr)
@@ -708,7 +753,8 @@ def cmd_pipeline(args):
 
         with _flight_recorder(args, "pipeline") as rec, \
                 obs.watch_compiles(rec):
-            results = run_drills(log=lambda m: print(m, file=sys.stderr))
+            results = run_drills(log=lambda m: print(m, file=sys.stderr),
+                                 only=args.only)
             ok = all(r["ok"] for r in results)
             if rec.enabled:
                 rec.annotate_meta(drills=len(results), drills_ok=ok)
@@ -1096,6 +1142,13 @@ def main(argv=None) -> int:
     e.add_argument("--fake-llm", action="store_true",
                    help="deterministic offline codegen backend")
     e.add_argument("--checkpoint", default="", help="evolution checkpoint path")
+    e.add_argument("--wal", default="",
+                   help="generation write-ahead log path "
+                        "(fks_tpu.resilience.wal): drafted candidates and "
+                        "eval outcomes are fsync'd mid-generation and the "
+                        "loop checkpoints every generation — a kill "
+                        "mid-generation resumes without re-spending LLM "
+                        "calls or device evals (pair with --checkpoint)")
     e.add_argument("--out", default="", help="directory for champion JSONs")
     e.add_argument("--generations", type=int, default=None)
     e.add_argument("--parametric-rounds", type=int, default=None,
@@ -1209,6 +1262,28 @@ def main(argv=None) -> int:
     sv.add_argument("--max-wait-ms", type=float, default=5.0,
                     help="flush policy: max ms the oldest pending request "
                          "waits for batch-mates (default 5)")
+    sv.add_argument("--request-deadline-s", type=float, default=60.0,
+                    help="per-request deadline budget in seconds (default "
+                         "60, the old hardcoded HTTP timeout); a request's "
+                         "own deadline_ms field wins; shed/expired "
+                         "requests answer a structured 503 with "
+                         "Retry-After instead of hanging (0 = no "
+                         "deadline)")
+    sv.add_argument("--max-queue", type=int, default=0,
+                    help="bounded request queue: admission control sheds "
+                         "submits beyond this depth with a typed 503 "
+                         "(0 = unbounded, the historical behaviour)")
+    sv.add_argument("--degraded-fallback", action="store_true",
+                    help="arm degraded-mode serving: on a classified "
+                         "device fault, atomically flip to a reduced-"
+                         "batch exact-CPU fallback engine (same champion "
+                         "and ladder) and rebuild the primary off the "
+                         "request path; recovery is probation-gated")
+    sv.add_argument("--drain-state", default="",
+                    help="on SIGTERM, drain the batcher and persist the "
+                         "replay buffer + summary to this path (loaded "
+                         "back on the next start to refill shadow-eval "
+                         "replay traffic)")
     sv.add_argument("--prefilter-k", type=int, default=None,
                     help="SimConfig.node_prefilter_k override (default: "
                          "auto via the policy-cost probe)")
@@ -1274,8 +1349,15 @@ def main(argv=None) -> int:
                          "matrix (corrupt champion, device-eval error, "
                          "p99 regression, kill -9 at every state, "
                          "rollback-on-burn, zero-recompile swap, LLM "
-                         "outage) and exit nonzero on any failure — the "
+                         "outage, plus the resilience matrix: deadline "
+                         "storm, queue overload, device loss mid-batch, "
+                         "degrade-then-recover, SIGTERM drain, WAL "
+                         "resume) and exit nonzero on any failure — the "
                          "run_full_suite promotion gate")
+    pp.add_argument("--only", default="",
+                    help="comma-separated drill-name substrings: run only "
+                         "the matching drills (e.g. "
+                         "--only deadline_storm,wal_resume)")
     pp.set_defaults(fn=cmd_pipeline)
 
     r = sub.add_parser("report",

@@ -33,7 +33,8 @@ import jax
 from fks_tpu import obs
 from fks_tpu.funsearch import llm as llm_mod
 from fks_tpu.funsearch import template
-from fks_tpu.funsearch.backend import CodeEvaluator
+from fks_tpu.funsearch.backend import CodeEvaluator, EvalRecord
+from fks_tpu.resilience.wal import GenerationWAL
 from fks_tpu.sim.engine import SimConfig
 
 
@@ -306,6 +307,15 @@ class FunSearch:
         self._exact_memo: dict = {}  # canonical AST key -> exact score
         self._scenario_memo: dict = {}  # key -> per-scenario exact scores
         self.best_exact: Optional[float] = None
+        # generation WAL (fks_tpu.resilience.wal): when attached (run()'s
+        # ``wal_path``), drafted codes and eval outcomes are durably
+        # logged mid-generation and the loop checkpoints at EVERY
+        # generation boundary — a kill mid-generation resumes without
+        # re-spending LLM calls or device evals
+        self.wal: Optional[GenerationWAL] = None
+        self.checkpoint_path: Optional[str] = None
+        self.wal_replayed_codes = 0  # lifetime resume accounting
+        self.wal_replayed_evals = 0
 
     # ----- population mechanics (reference funsearch_integration.py:174-215)
 
@@ -494,11 +504,26 @@ class FunSearch:
                 feedback = (
                     f"best fitness so far {self.best[1]:.4f}; higher "
                     "utilization with less GPU fragmentation wins")
+            cached_codes = (self.wal.pending_codes(self.generation)
+                            if self.wal is not None else None)
             with obs.span("llm", generation=self.generation,
                           candidates=n_new) as lt:
-                codes = llm_mod.generate_many(
-                    self.generator, n_new, self._sample_parents, feedback,
-                    cfg.max_workers)
+                if cached_codes is not None:
+                    # WAL replay: the drafted candidates survived the
+                    # kill; burn the parent draws generate_many would
+                    # have made (exactly n_new, at submit time) so the
+                    # RNG trajectory matches the original attempt, and
+                    # issue ZERO LLM calls
+                    for _ in range(n_new):
+                        self._sample_parents()
+                    codes = list(cached_codes)
+                    self.wal_replayed_codes += len(codes)
+                else:
+                    codes = llm_mod.generate_many(
+                        self.generator, n_new, self._sample_parents,
+                        feedback, cfg.max_workers)
+                    if self.wal is not None:
+                        self.wal.record_codes(self.generation, codes)
         llm_s = lt.seconds
         # outage tracking: a generation that ASKED for candidates and got
         # none back means every LLM call failed (generate() returns None
@@ -513,7 +538,7 @@ class FunSearch:
         # and its EvalRecord dataclasses are opaque to block_until_ready
         with obs.span("evaluate", generation=self.generation,
                       candidates=len(codes)) as t:
-            records = self.evaluator.evaluate(codes)
+            records = self._evaluate_with_wal(codes, cached_codes)
         eval_s = t.seconds
         sandbox_failed, transpile_failed = _failure_counts(records)
 
@@ -579,7 +604,53 @@ class FunSearch:
                 codes, eval_s, llm_s, sandbox_failed, transpile_failed,
                 fallbacks0, wd_flags, parity, budget_alerts, budget_rungs,
                 accepted, rejected)
+        if self.wal is not None:
+            # checkpoint BEFORE the WAL commit: a kill between the two
+            # leaves stale uncommitted records for THIS generation, which
+            # the next resume (restored to this generation) never reads —
+            # whereas commit-before-checkpoint would lose the generation
+            if self.checkpoint_path:
+                self.checkpoint(self.checkpoint_path)
+            self.wal.commit(self.generation)
         return stats
+
+    def _evaluate_with_wal(self, codes: List[str],
+                           cached_codes) -> List[EvalRecord]:
+        """Evaluate, replaying WAL-cached outcomes on resume: candidates
+        whose eval already landed in the WAL are reconstructed (zero
+        device work); only the fresh remainder runs, and each fresh
+        outcome is durably logged before ranking sees it."""
+        if self.wal is None:
+            return self.evaluator.evaluate(codes)
+        cached = self.wal.cached_evals(self.generation)
+        keys = [GenerationWAL.code_key(c) for c in codes]
+        fresh_idx = [i for i, k in enumerate(keys) if k not in cached]
+        fresh = (self.evaluator.evaluate([codes[i] for i in fresh_idx])
+                 if fresh_idx else [])
+        by_idx = {}
+        for i, r in zip(fresh_idx, fresh):
+            by_idx[i] = r
+            self.wal.record_eval(self.generation, r)
+        records: List[EvalRecord] = []
+        replayed = 0
+        for i, code in enumerate(codes):
+            if i in by_idx:
+                records.append(by_idx[i])
+            else:
+                e = cached[keys[i]]
+                records.append(EvalRecord(
+                    code=code, score=e["score"], error=e["error"],
+                    scenario_scores=e["scenario_scores"],
+                    aggregation=e["aggregation"],
+                    budget_rung=e["budget_rung"]))
+                replayed += 1
+        self.wal_replayed_evals += replayed
+        if cached_codes is not None or replayed:
+            self.recorder.event(
+                "resume_wal", generation=self.generation,
+                cached_codes=len(cached_codes or []), cached_evals=replayed,
+                fresh_evals=len(fresh_idx))
+        return records
 
     def _commit_generation(self, codes, eval_s, llm_s, sandbox_failed,
                            transpile_failed, fallbacks0, wd_flags, parity,
@@ -789,6 +860,10 @@ class FunSearch:
         tmp = path + ".tmp"
         with open(tmp, "w") as f:
             json.dump(state, f)
+            # fsync BEFORE the atomic rename: without it a crash can
+            # replace a good checkpoint with an empty/torn rename target
+            f.flush()
+            os.fsync(f.fileno())
         os.replace(tmp, path)
 
     #: config fields that change what a fitness NUMBER means (or how the
@@ -798,8 +873,14 @@ class FunSearch:
                    "robust_cvar_alpha", "population_size")
 
     def restore(self, path: str) -> None:
-        with open(path) as f:
-            state = json.load(f)
+        try:
+            with open(path) as f:
+                state = json.load(f)
+        except json.JSONDecodeError as e:
+            raise ValueError(
+                f"{path}: torn checkpoint (invalid JSON: {e}); delete it "
+                "or restore from a backup — resuming from half a state "
+                "would corrupt the population") from e
         if state.get("version") != 1:
             raise ValueError(f"unknown checkpoint version {state.get('version')}")
         stored = state.get("config") or {}
@@ -841,6 +922,7 @@ def run(workload, config: Optional[EvolutionConfig] = None,
         backend: Optional[llm_mod.TextBackend] = None,
         sim_config: SimConfig = SimConfig(),
         checkpoint_path: Optional[str] = None,
+        wal_path: Optional[str] = None,
         out_dir: Optional[str] = None,
         engine: str = "exact",
         log: Callable[[str], None] = print,
@@ -891,6 +973,18 @@ def run(workload, config: Optional[EvolutionConfig] = None,
     if checkpoint_path and os.path.exists(checkpoint_path):
         fs.restore(checkpoint_path)
         log(f"resumed from {checkpoint_path} at generation {fs.generation}")
+    if wal_path:
+        # preemption-safe mode: WAL + checkpoint-every-generation, so the
+        # pending window is exactly one generation and a kill -9
+        # mid-generation resumes without re-buying its LLM/device spend
+        fs.wal = GenerationWAL(wal_path)
+        fs.checkpoint_path = checkpoint_path
+        summ = fs.wal.summary()
+        if summ["records"]:
+            log(f"generation WAL {wal_path}: {summ['records']} records, "
+                f"{len(summ['committed'])} committed generations"
+                + (f", {summ['skipped_lines']} torn lines skipped"
+                   if summ["skipped_lines"] else ""))
     fs.interrupted = False  # callers: champions already persisted when True
     try:
         fs.run_evolution()

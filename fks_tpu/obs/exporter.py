@@ -201,6 +201,33 @@ def to_openmetrics(run_dir: str) -> str:
         fam("slo_observed", "gauge", "observed SLI value").add(
             b.get("observed"), run_id=run_id, slo=name)
 
+    # serve-tier health (fks_tpu.resilience): the latest serve summary's
+    # queue/shed/degrade view — what /healthz reports, as gauges
+    latest_serve = None
+    for s in (m for m in metrics if m.get("kind") == "serve"):
+        latest_serve = s
+    if latest_serve is not None:
+        s = latest_serve
+        fam("serve_queue_depth", "gauge",
+            "requests admitted but not yet batched").add(
+            s.get("queue_depth"), run_id=run_id)
+        fam("serve_shed_total", "gauge",
+            "requests refused by admission control (queue full / "
+            "deadline unmeetable / draining)").add(
+            s.get("shed_total"), run_id=run_id)
+        fam("serve_shed_rate", "gauge",
+            "fraction of submit attempts shed at admission").add(
+            s.get("shed_rate"), run_id=run_id)
+        fam("serve_deadline_expired_total", "gauge",
+            "admitted requests completed with DeadlineExceeded").add(
+            s.get("expired"), run_id=run_id)
+        if s.get("engine_state") is not None:
+            fam("serve_degraded", "gauge",
+                "1 while serving on the degraded fallback engine "
+                "(degraded/probation), 0 when normal").add(
+                0 if s.get("engine_state") == "normal" else 1,
+                run_id=run_id, state=str(s.get("engine_state")))
+
     counts: Dict[str, int] = {}
     for e in events:
         kind = e.get("kind", "?")

@@ -16,6 +16,10 @@ behaviour — serve keeps answering on the old champion throughout:
 - total LLM outage                            -> evolve loop halts with
   the llm_outage circuit breaker, checkpoint on disk
 
+plus the resilience matrix (fks_tpu.resilience.drills): deadline storms,
+queue overload, device loss mid-batch, degrade-then-recover, SIGTERM
+drain, and WAL resume mid-generation.
+
 Everything is seeded and fault-driven — no timing races, no
 probabilities — so the matrix is a CI gate (``run_full_suite``), a CLI
 (``cli pipeline --drill``), and a slow-tier test, all from one function.
@@ -92,16 +96,25 @@ class DrillStack:
         return [f.result(timeout=300) for f in futs]
 
 
-def run_drills(log: Callable[[str], None] = print) -> List[Dict[str, Any]]:
-    """Run the whole matrix; one result dict per drill, ``ok`` per drill."""
+def run_drills(log: Callable[[str], None] = print,
+               only: str = "") -> List[Dict[str, Any]]:
+    """Run the whole matrix; one result dict per drill, ``ok`` per drill.
+    ``only`` is a comma-separated list of name substrings — the CLI's
+    ``--only`` and the run_full_suite resilience gate run a subset
+    without paying for the rest of the matrix."""
+    from fks_tpu.resilience.drills import RESILIENCE_DRILLS
+
     stack = DrillStack()
     results = []
+    filters = [t.strip() for t in only.split(",") if t.strip()]
     for drill in (_drill_corrupt_champion, _drill_device_eval_error,
                   _drill_p99_regression_rejected, _drill_kill_pending,
                   _drill_kill_shadow, _drill_kill_promoted,
                   _drill_rollback_on_burn, _drill_zero_recompile_swap,
-                  _drill_llm_outage):
+                  _drill_llm_outage, *RESILIENCE_DRILLS):
         name = drill.__name__.replace("_drill_", "")
+        if filters and not any(f in name for f in filters):
+            continue
         try:
             detail = drill(stack)
             ok = bool(detail.pop("ok"))

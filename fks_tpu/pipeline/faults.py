@@ -71,6 +71,50 @@ class OutageBackend:
         raise FaultInjected("injected LLM outage")
 
 
+class FlakyEngineProxy:
+    """A serve engine that loses its device for the first ``failures``
+    batches (raising a classified ``DeviceFault``), then recovers —
+    the device-loss-mid-batch drill. Everything else delegates to the
+    real warm engine, so parity assertions run against the same ladder."""
+
+    def __init__(self, inner, failures: int = 1) -> None:
+        self._inner = inner
+        self._failures_left = failures
+        self.faults_raised = 0
+
+    def __getattr__(self, name):  # envelope, base_pods, reference_answer…
+        return getattr(self._inner, name)
+
+    def answer_batch(self, pod_lists):
+        if self._failures_left > 0:
+            self._failures_left -= 1
+            self.faults_raised += 1
+            from fks_tpu.resilience.degrade import DeviceFault
+            raise DeviceFault("injected device loss mid-batch")
+        return self._inner.answer_batch(pod_lists)
+
+
+class CountingBackend:
+    """A FakeLLM wrapper that counts ``complete`` calls — the WAL-resume
+    drill's zero-LLM-calls assertion."""
+
+    def __init__(self, seed: int = 0) -> None:
+        from fks_tpu.funsearch import llm as llm_mod
+
+        self._inner = llm_mod.FakeLLM(seed=seed)
+        self.calls = 0
+
+    def complete(self, prompt: str) -> str:
+        self.calls += 1
+        return self._inner.complete(prompt)
+
+    def getstate(self):
+        return self._inner.getstate()
+
+    def setstate(self, state) -> None:
+        self._inner.setstate(state)
+
+
 def write_champion(directory: str, code: str, score: float,
                    name: str = "drill", generation: int = 1) -> str:
     """Write a well-formed champion JSON the way the evolve loop does

@@ -1,0 +1,61 @@
+"""Always-on resilience layer (ISSUE 13): deadlines + load shedding,
+degraded-mode serving, and preemption-safe drain/resume.
+
+Three pillars, all HOST-SIDE control plane — nothing here touches the
+sim/ lowering, so every compiled program (and the jaxpr-pin manifest)
+is bit-identical whether resilience features are on or off:
+
+- ``deadline`` / ``admission``: per-request deadline budgets threaded
+  from the JSONL/HTTP fronts through the ``RequestBatcher``, a bounded
+  queue with admission control that sheds (typed ``ShedError`` -> HTTP
+  503 + Retry-After) when the projected wait exceeds the deadline, and
+  deadline-expired Futures completed with ``DeadlineExceeded`` instead
+  of hanging;
+- ``degrade``: device-fault classification (XlaRuntimeError, watchdog
+  NaN-flood, engine-build failure) that atomically flips a
+  ``ServeService`` to a reduced-batch exact-CPU fallback engine via the
+  existing ``swap_engine``, rebuilds the AOT engine off the request
+  path, and gates auto-recovery through a probation window (the
+  ``pipeline/controller.py`` probation idiom);
+- ``drain`` / ``wal``: a SIGTERM coordinator that drains the batcher
+  (completing or shedding every in-flight Future), persists the serve
+  replay buffer, and a generation-level write-ahead log for the evolve
+  loop (fsync'd, torn-tail tolerant like ``pipeline/state.py``) so a
+  kill -9 mid-generation resumes without re-spending LLM calls or
+  device evals for already-completed candidates.
+
+Pure host code at import time (no jax) — the drills module imports the
+serve stack lazily inside each drill.
+"""
+from fks_tpu.resilience.admission import AdmissionConfig, AdmissionController
+from fks_tpu.resilience.deadline import (
+    Deadline, DeadlineExceeded, ResilienceError, ShedError,
+)
+from fks_tpu.resilience.degrade import (
+    DegradeConfig, DegradedModeManager, DeviceFault, EngineBuildError,
+    NaNFlood, classify_fault, exact_fallback_factory,
+)
+from fks_tpu.resilience.drain import (
+    DrainCoordinator, load_serve_state, persist_serve_state,
+)
+from fks_tpu.resilience.wal import GenerationWAL
+
+__all__ = [
+    "AdmissionConfig",
+    "AdmissionController",
+    "Deadline",
+    "DeadlineExceeded",
+    "DegradeConfig",
+    "DegradedModeManager",
+    "DeviceFault",
+    "DrainCoordinator",
+    "EngineBuildError",
+    "GenerationWAL",
+    "NaNFlood",
+    "ResilienceError",
+    "ShedError",
+    "classify_fault",
+    "exact_fallback_factory",
+    "load_serve_state",
+    "persist_serve_state",
+]

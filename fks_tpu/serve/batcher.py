@@ -24,10 +24,11 @@ dependency points artifact -> batcher):
 """
 from __future__ import annotations
 
+import dataclasses
 import queue
 import threading
 import time
-from concurrent.futures import Future
+from concurrent.futures import Future, InvalidStateError
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import jax
@@ -36,6 +37,8 @@ import numpy as np
 
 from fks_tpu.data.entities import PodArrays, Workload
 from fks_tpu.parallel.traces import strip_ids
+from fks_tpu.resilience.admission import AdmissionConfig, AdmissionController
+from fks_tpu.resilience.deadline import Deadline, DeadlineExceeded, ShedError
 from fks_tpu.sim.evaluator import max_snapshot_count, snapshot_trigger_table
 
 #: query pod schema — the reference entity field names (simulator/
@@ -165,30 +168,74 @@ class RequestBatcher:
     classic latency/occupancy trade. The handler receives
     ``(queries, enqueue_times)`` and returns one answer per query in
     order (scatter-back is positional); a handler exception fails every
-    future in the batch. ``close()`` flushes the remainder and joins."""
+    future in the batch. ``close()`` flushes the remainder and joins.
+
+    Resilience hooks (fks_tpu.resilience):
+
+    - every submit passes ADMISSION CONTROL: a bounded queue
+      (``max_queue``) plus a projected-wait check against the request's
+      ``Deadline`` — refused work raises ``ShedError`` (an HTTP 503 with
+      Retry-After upstream) instead of queueing to miss its deadline;
+    - a request whose deadline expires while queued is completed with
+      ``DeadlineExceeded``, never silently handled late;
+    - every dequeued Future is completed EXACTLY ONCE — the batch-failure
+      path, a short handler answer list, and drain-time shedding all
+      resolve through one ``_complete`` funnel;
+    - ``drain()`` is the SIGTERM path: stop admitting, give the worker a
+      grace budget to finish real work, then shed whatever remains with
+      a typed error so no client ever hangs on a dying server."""
 
     def __init__(self, handle_batch: Callable[[list, list], list],
-                 max_batch: int = 8, max_wait_s: float = 0.005):
+                 max_batch: int = 8, max_wait_s: float = 0.005,
+                 max_queue: int = 0,
+                 admission_cfg: Optional[AdmissionConfig] = None,
+                 recorder: Any = None):
+        from fks_tpu import obs
+
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
         self._handle = handle_batch
         self.max_batch = int(max_batch)
         self.max_wait_s = float(max_wait_s)
+        cfg = admission_cfg or AdmissionConfig()
+        if max_queue:
+            cfg = dataclasses.replace(cfg, max_queue=int(max_queue))
+        self.admission = AdmissionController(cfg)
+        self.recorder = recorder if recorder is not None else obs.get_recorder()
         self._q: "queue.SimpleQueue" = queue.SimpleQueue()
         self.batches = 0
         self.submitted = 0
+        self.completed = 0
+        self.expired = 0
+        self.shed_inflight = 0  # dequeued futures shed at drain time
+        self.shed_draining = 0  # submits refused because drain started
         self._occupancy_sum = 0.0
         self._closed = False
+        self._draining = False
+        self._shed_mode = False  # grace exhausted: flush = shed, not run
         self._thread = threading.Thread(
             target=self._loop, name="serve-batcher", daemon=True)
         self._thread.start()
 
-    def submit(self, query) -> Future:
+    def submit(self, query, deadline: Optional[Deadline] = None) -> Future:
+        if self._draining:  # before the closed check: drain() sets both,
+            # and a drained server sheds with a TYPED error
+            self.shed_draining += 1
+            self.recorder.event("shed", reason="draining",
+                                queue_depth=self.admission.depth)
+            raise ShedError("server is draining", reason="draining")
         if self._closed:
             raise RuntimeError("batcher is closed")
+        try:
+            self.admission.admit(deadline)
+        except ShedError as e:
+            self.recorder.event("shed", reason=e.reason,
+                                queue_depth=self.admission.depth,
+                                retry_after_s=e.retry_after_s)
+            raise
         self.submitted += 1
         fut: Future = Future()
-        self._q.put((query, fut, time.perf_counter()))
+        self._q.put((query, fut, time.perf_counter(), deadline))
         return fut
 
     def close(self) -> None:
@@ -198,12 +245,52 @@ class RequestBatcher:
         self._q.put(None)
         self._thread.join()
 
+    def drain(self, grace_s: float = 5.0) -> Dict[str, Any]:
+        """SIGTERM path: shed new submits, let the worker finish queued
+        work within ``grace_s``, then shed the remainder with a typed
+        error. Returns completion accounting; never leaves a Future
+        pending."""
+        if self._closed:
+            return {"pending": 0, "completed": 0, "expired": 0,
+                    "shed": 0, "stuck": False}
+        pending_at = self.admission.depth
+        c0, e0, s0 = self.completed, self.expired, self.shed_inflight
+        self._draining = True
+        self._q.put(None)
+        self._thread.join(max(0.0, float(grace_s)))
+        if self._thread.is_alive():
+            # grace exhausted — remaining flushes shed instead of running
+            self._shed_mode = True
+            self._thread.join(max(0.1, float(grace_s)))
+        self._closed = True
+        return {"pending": pending_at,
+                "completed": self.completed - c0,
+                "expired": self.expired - e0,
+                "shed": self.shed_inflight - s0,
+                "stuck": self._thread.is_alive()}
+
     @property
     def mean_occupancy(self) -> float:
         """Mean fraction of max_batch filled per flushed batch."""
         return self._occupancy_sum / self.batches if self.batches else 0.0
 
     # ----- internals
+
+    @staticmethod
+    def _complete(fut: Future, *, result=None, exc=None) -> bool:
+        """The single completion funnel: every dequeued Future resolves
+        through here exactly once (a cancelled or already-completed
+        Future is left alone, never raised over)."""
+        if not fut.set_running_or_notify_cancel():
+            return False  # client cancelled while queued
+        try:
+            if exc is not None:
+                fut.set_exception(exc)
+            else:
+                fut.set_result(result)
+        except InvalidStateError:  # pragma: no cover — funnel invariant
+            return False
+        return True
 
     def _loop(self) -> None:
         pending: list = []
@@ -218,7 +305,7 @@ class RequestBatcher:
                 self._flush(pending)
                 pending = []
                 continue
-            if item is None:  # close sentinel
+            if item is None:  # close/drain sentinel
                 self._flush(pending)
                 return
             pending.append(item)
@@ -229,15 +316,45 @@ class RequestBatcher:
     def _flush(self, pending: list) -> None:
         if not pending:
             return
+        self.admission.release(len(pending))
+        if self._shed_mode:  # drain grace exhausted: typed shed, no work
+            for _, fut, _, _ in pending:
+                if self._complete(fut, exc=ShedError(
+                        "server shut down before this request ran")):
+                    self.shed_inflight += 1
+            return
+        live: list = []
+        for entry in pending:
+            _, fut, _, deadline = entry
+            if deadline is not None and deadline.expired():
+                if self._complete(fut, exc=DeadlineExceeded(
+                        "deadline expired while queued")):
+                    self.expired += 1
+                    self.admission.note_expired()
+            else:
+                live.append(entry)
+        if not live:
+            return
         self.batches += 1
-        self._occupancy_sum += len(pending) / self.max_batch
-        queries = [q for q, _, _ in pending]
-        enq = [t for _, _, t in pending]
+        self._occupancy_sum += len(live) / self.max_batch
+        queries = [q for q, _, _, _ in live]
+        enq = [t for _, _, t, _ in live]
+        t0 = time.perf_counter()
         try:
             answers = self._handle(queries, enq)
         except Exception as e:  # noqa: BLE001 — fail the batch, not the loop
-            for _, fut, _ in pending:
-                fut.set_exception(e)
+            for _, fut, _, _ in live:
+                self._complete(fut, exc=e)
             return
-        for (_, fut, _), ans in zip(pending, answers):
-            fut.set_result(ans)
+        self.admission.note_batch(len(live), time.perf_counter() - t0)
+        answers = list(answers)
+        for i, (_, fut, _, _) in enumerate(live):
+            if i < len(answers):
+                if self._complete(fut, result=answers[i]):
+                    self.completed += 1
+            else:
+                # a short answer list must FAIL the unmatched futures,
+                # never leave them hanging (the old zip() bug)
+                self._complete(fut, exc=RuntimeError(
+                    f"batch handler returned {len(answers)} answers for "
+                    f"{len(live)} queries"))

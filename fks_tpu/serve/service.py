@@ -26,6 +26,8 @@ import numpy as np
 from fks_tpu import obs
 from fks_tpu.obs.history import SLOConfig, record_slo_burn
 from fks_tpu.obs.watchdog import ParitySentinel
+from fks_tpu.resilience.deadline import Deadline, ResilienceError
+from fks_tpu.resilience.degrade import DegradeConfig, DegradedModeManager
 from fks_tpu.serve.artifact import ServeEngine
 from fks_tpu.serve.batcher import RequestBatcher, pods_to_dicts
 
@@ -44,10 +46,15 @@ class ServeService:
                  max_batch: Optional[int] = None, max_wait_s: float = 0.005,
                  audit_every: int = 0, audit_tol: float = 1e-5,
                  slo: Optional[SLOConfig] = None, slo_every: int = 100,
-                 replay_buffer: int = 64):
+                 replay_buffer: int = 64,
+                 max_queue: int = 0, default_deadline_s: float = 0.0):
         self.engine = engine
         self.recorder = recorder if recorder is not None else obs.get_recorder()
         self.audit_every = int(audit_every)
+        # resilience knobs: bounded queue + per-request deadline default
+        # (a query's own deadline_ms always wins); 0 disables each
+        self.default_deadline_s = float(default_deadline_s)
+        self._degrade: Optional[DegradedModeManager] = None
         # serve-tier SLO (fks_tpu.obs.history.SLOConfig): p99/qps targets
         # priced as error-budget burn rates — one slo_burn metric every
         # ``slo_every`` requests plus one at summary(), so ``cli watch``
@@ -60,7 +67,8 @@ class ServeService:
         self._batcher = RequestBatcher(
             self._handle_batch,
             max_batch=max_batch or engine.envelope.max_batch,
-            max_wait_s=max_wait_s)
+            max_wait_s=max_wait_s, max_queue=max_queue,
+            recorder=self.recorder)
         self._seq = 0
         self._latencies_ms: List[float] = []
         self._t_first: Optional[float] = None
@@ -88,11 +96,35 @@ class ServeService:
         self.swaps += 1
         return old
 
+    def enable_degraded_mode(self, fallback_factory, rebuild_factory=None,
+                             config: Optional[DegradeConfig] = None
+                             ) -> DegradedModeManager:
+        """Arm device-fault degradation: a classified device fault inside
+        ``_handle_batch`` flips this service to ``fallback_factory``'s
+        reduced-batch exact engine (via ``swap_engine``) and retries the
+        batch there, while ``rebuild_factory`` rebuilds the primary off
+        the request path; recovery is gated through probation."""
+        self._degrade = DegradedModeManager(
+            self, fallback_factory, rebuild_factory=rebuild_factory,
+            config=config, recorder=self.recorder)
+        return self._degrade
+
+    @property
+    def degrade(self) -> Optional[DegradedModeManager]:
+        return self._degrade
+
     def recent_queries(self, n: int) -> List[List[dict]]:
         """The last ``n`` answered pod lists, oldest first — shadow-eval
         replay traffic."""
         items = list(self._replay)
         return [list(q) for q in items[-max(0, int(n)):]]
+
+    def preload_replay(self, queries: Sequence[Sequence[dict]]) -> int:
+        """Refill the replay buffer from a persisted serve state (the
+        drain/resume path) so shadow evals have traffic from minute one."""
+        for q in queries:
+            self._replay.append([dict(p) for p in q])
+        return len(self._replay)
 
     @property
     def requests_served(self) -> int:
@@ -135,12 +167,40 @@ class ServeService:
 
     def submit(self, query: Dict[str, Any]):
         """Resolve + enqueue; returns a Future resolving to the answer
-        dict (with ``id`` and ``latency_ms`` attached)."""
+        dict (with ``id`` and ``latency_ms`` attached). Raises
+        ``ShedError`` when admission control refuses the request (queue
+        full / deadline unmeetable / draining)."""
         rid, pods = self.resolve_query(query)
-        return self._batcher.submit((rid, pods))
+        deadline = Deadline.from_query(query, self.default_deadline_s)
+        return self._batcher.submit((rid, pods), deadline=deadline)
 
     def close(self) -> None:
         self._batcher.close()
+
+    def drain(self, grace_s: float = 5.0) -> Dict[str, Any]:
+        """Preemption path: stop admitting, complete or shed every
+        in-flight Future within the grace budget. Returns the batcher's
+        completion accounting."""
+        return self._batcher.drain(grace_s)
+
+    def healthz(self) -> Dict[str, Any]:
+        """The liveness/readiness view the HTTP front serves at
+        ``/healthz`` and the exporter publishes as gauges."""
+        adm = self._batcher.admission
+        degrade = self._degrade.healthz() if self._degrade is not None \
+            else {"state": "normal", "flips": 0, "recoveries": 0,
+                  "last_fault": ""}
+        return {
+            "ok": degrade["state"] != "dead",
+            "engine": self.engine.engine_name,
+            "engine_state": degrade["state"],
+            "queue_depth": adm.depth,
+            "shed_total": adm.shed_total + self._batcher.shed_draining,
+            "shed_rate": round(adm.shed_rate, 4),
+            "expired": self._batcher.expired,
+            "requests_served": self.requests_served,
+            "degrade": degrade,
+        }
 
     # ----- batch handling (batcher thread)
 
@@ -150,7 +210,15 @@ class ServeService:
         # swap ``self.engine`` concurrently, and a batch must be answered
         # (and audited) by ONE engine end to end
         engine = self.engine
-        answers = engine.answer_batch([pods for _, pods in items])
+        try:
+            answers = engine.answer_batch([pods for _, pods in items])
+        except Exception as e:  # noqa: BLE001 — maybe a device fault
+            if self._degrade is None or not self._degrade.on_fault(e):
+                raise
+            # the manager flipped us to the fallback engine: retry the
+            # batch there (re-pin — swap_engine already landed)
+            engine = self.engine
+            answers = engine.answer_batch([pods for _, pods in items])
         done = time.perf_counter()
         if self._t_first is None:
             self._t_first = min(enq_times)
@@ -177,6 +245,8 @@ class ServeService:
             self._slo_marks = len(self._latencies_ms) // self.slo_every
             record_slo_burn(self.slo, self._latencies_ms,
                             self._elapsed(), recorder=self.recorder)
+        if self._degrade is not None:
+            self._degrade.after_batch(len(items))
         return answers
 
     def _audit(self, engine: ServeEngine, rid: str, pods: List[dict],
@@ -211,6 +281,13 @@ class ServeService:
             "audits": self.audits,
             "audit_failures": self.audit_failures,
             "swaps": self.swaps,
+            "queue_depth": self._batcher.admission.depth,
+            "shed_total": (self._batcher.admission.shed_total
+                           + self._batcher.shed_draining),
+            "shed_rate": round(self._batcher.admission.shed_rate, 4),
+            "expired": self._batcher.expired,
+            "engine_state": (self._degrade.state
+                             if self._degrade is not None else "normal"),
         }
         if self.slo.enabled:
             out["slo"] = record_slo_burn(
@@ -242,6 +319,9 @@ def run_jsonl(service: ServeService, stream_in=None, stream_out=None) -> int:
         try:
             query = json.loads(line)
             results.append(("", service.submit(query)))
+        except ResilienceError as e:  # shed at admission: typed 503 body
+            errors += 1
+            results.append(("", {"id": f"line{lineno}", **e.to_json()}))
         except Exception as e:  # noqa: BLE001 — per-line 4xx semantics
             errors += 1
             results.append(("", {"id": f"line{lineno}", "error": str(e)}))
@@ -252,6 +332,9 @@ def run_jsonl(service: ServeService, stream_in=None, stream_out=None) -> int:
         else:
             try:
                 ans = res.result()
+            except ResilienceError as e:
+                errors += 1
+                ans = e.to_json()
             except Exception as e:  # noqa: BLE001
                 errors += 1
                 ans = {"error": str(e)}
@@ -260,27 +343,40 @@ def run_jsonl(service: ServeService, stream_in=None, stream_out=None) -> int:
 
 
 def run_http(service: ServeService, port: int, *, host: str = "127.0.0.1",
-             max_requests: Optional[int] = None) -> None:
+             max_requests: Optional[int] = None,
+             deadline_s: float = 60.0,
+             drain_coordinator=None) -> None:
     """Localhost-only HTTP front: POST /query (request JSON -> answer
-    JSON), GET /stats (service summary), GET /healthz. ``max_requests``
-    stops the listener after N queries (test hook); otherwise blocks
-    until interrupted."""
+    JSON), GET /stats (service summary), GET /healthz (resilience view).
+    ``deadline_s`` bounds how long a POST waits on its Future (the old
+    hardcoded 60s); shed/expired/timed-out requests answer a STRUCTURED
+    503 with a Retry-After hint instead of a hung socket. A
+    ``DrainCoordinator`` (optional) gets the server-shutdown callback so
+    SIGTERM drains the batcher, persists state, then closes the listener.
+    ``max_requests`` stops the listener after N queries (test hook);
+    otherwise blocks until interrupted."""
+    import concurrent.futures as cf
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
     served = {"n": 0}
 
     class Handler(BaseHTTPRequestHandler):
-        def _send(self, code: int, doc: dict) -> None:
+        def _send(self, code: int, doc: dict,
+                  retry_after_s: Optional[float] = None) -> None:
             body = json.dumps(doc).encode()
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))
+            if retry_after_s is not None:
+                self.send_header("Retry-After",
+                                 f"{max(0.0, retry_after_s):.3f}")
             self.end_headers()
             self.wfile.write(body)
 
         def do_GET(self):  # noqa: N802 — http.server API
             if self.path == "/healthz":
-                self._send(200, {"ok": True})
+                hz = service.healthz()
+                self._send(200 if hz["ok"] else 503, hz)
             elif self.path == "/stats":
                 self._send(200, service.summary(record=False))
             else:
@@ -293,8 +389,15 @@ def run_http(service: ServeService, port: int, *, host: str = "127.0.0.1",
             try:
                 n = int(self.headers.get("Content-Length", 0))
                 query = json.loads(self.rfile.read(n))
-                ans = service.submit(query).result(timeout=60.0)
+                ans = service.submit(query).result(
+                    timeout=deadline_s if deadline_s > 0 else None)
                 self._send(200, ans)
+            except ResilienceError as e:
+                self._send(e.http_status, e.to_json(),
+                           retry_after_s=e.retry_after_s)
+            except cf.TimeoutError:
+                self._send(503, {"error": f"no answer within {deadline_s}s",
+                                 "kind": "deadline"})
             except ValueError as e:
                 self._send(400, {"error": str(e)})
             except Exception as e:  # noqa: BLE001 — surface, don't crash
@@ -308,6 +411,10 @@ def run_http(service: ServeService, port: int, *, host: str = "127.0.0.1",
             pass
 
     server = ThreadingHTTPServer((host, port), Handler)
+    if drain_coordinator is not None:
+        drain_coordinator.add_callback(
+            lambda: __import__("threading").Thread(
+                target=server.shutdown, daemon=True).start())
     try:
         server.serve_forever()
     except KeyboardInterrupt:

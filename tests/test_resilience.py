@@ -1,0 +1,524 @@
+"""Resilience-layer tests (fks_tpu.resilience) — the ISSUE-13
+acceptance criteria, as tests:
+
+- deadline budgets: expired requests fail with TYPED errors (shed at
+  admission or ``DeadlineExceeded`` in queue), never hang;
+- exactly-once Future completion in the batcher — a handler returning
+  too few answers fails the unmatched Futures instead of zipping them
+  into silence, and a handler exception fails every live Future once;
+- bounded-queue shedding with a Retry-After hint, typed post-drain
+  shed, and the legacy post-close RuntimeError kept intact;
+- degraded-mode serving: a device fault flips the service to the
+  reduced-batch exact fallback with 0.0 parity drift, then recovers
+  through probation back to the primary;
+- preemption safety: a REAL ``SIGTERM`` through the installed handler
+  drains every Future and persists the replay buffer; torn state files
+  are refused on load;
+- the generation WAL: fsync'd records, torn-tail tolerance, and a
+  mid-generation kill resumed with zero LLM calls and zero device
+  evaluations;
+- fsync'd checkpoints: a torn (half-written) checkpoint is refused
+  with a targeted error instead of corrupting the population;
+- the JSONL schema vocabulary: the new ``shed``/``degraded``/``drain``/
+  ``resume_wal`` kinds enforce their required keys.
+
+Everything here is CPU-hosted and event-gated (no sleeps as
+synchronization); the serving stack is built once per module.
+"""
+import json
+import os
+import signal
+import sys
+import threading
+
+import pytest
+
+from fks_tpu.resilience import (
+    AdmissionConfig, AdmissionController, Deadline, DeadlineExceeded,
+    DegradeConfig, DrainCoordinator, GenerationWAL, ResilienceError,
+    ShedError, classify_fault, load_serve_state,
+)
+from fks_tpu.serve.batcher import RequestBatcher
+
+# ------------------------------------------------------- deadline units
+
+
+def test_deadline_from_query_and_expiry():
+    d = Deadline.after(1e-9)
+    assert d.expired()
+    assert d.remaining() <= 0.0
+    q = {"deadline_ms": 50.0}
+    d = Deadline.from_query(q, default_s=0.0)
+    assert d is not None and not d.expired()
+    assert 0.0 < d.remaining() <= 0.05 + 1e-6
+    # the per-query deadline wins over the service default
+    tight = Deadline.from_query({"deadline_ms": 0.0}, default_s=60.0)
+    assert tight is not None and tight.expired()
+
+
+def test_deadline_absent_means_none():
+    assert Deadline.from_query({}, default_s=0.0) is None
+    d = Deadline.from_query({}, default_s=60.0)
+    assert d is not None and not d.expired()
+
+
+def test_resilience_error_json_shape():
+    e = ShedError("queue full", retry_after_s=0.25, reason="queue_full")
+    j = e.to_json()
+    assert j["kind"] == "shed" and j["retry_after_s"] == 0.25
+    assert e.http_status == 503
+    assert "retry_after_s" not in DeadlineExceeded("late").to_json()
+
+
+# ------------------------------------------------------ admission units
+
+
+def test_admission_queue_full_shed():
+    ctl = AdmissionController(AdmissionConfig(max_queue=2))
+    ctl.admit(None)
+    ctl.admit(None)
+    with pytest.raises(ShedError) as ei:
+        ctl.admit(None)
+    assert ei.value.reason == "queue_full"
+    assert ei.value.retry_after_s >= ctl.cfg.min_retry_after_s
+    assert ctl.shed_queue_full == 1 and ctl.depth == 2
+    ctl.release(2)
+    ctl.admit(None)  # room again
+    assert ctl.submitted == 3
+
+
+def test_admission_deadline_budget_shed():
+    ctl = AdmissionController(AdmissionConfig(max_queue=0))
+    # cold estimator: never shed on a guess, even with a queue
+    ctl.admit(Deadline.after(0.001))
+    # observed service time makes the projected wait exceed the budget
+    ctl.note_batch(1, 1.0)  # 1 s per request
+    with pytest.raises(ShedError) as ei:
+        ctl.admit(Deadline.after(0.01))
+    assert ei.value.reason == "deadline_budget"
+    assert ctl.shed_deadline == 1
+    # a roomy deadline is still admitted under the same estimate
+    ctl.admit(Deadline.after(60.0))
+    assert ctl.shed_rate == pytest.approx(1.0 / 3.0)
+
+
+def test_admission_ewma_tracks_batches():
+    ctl = AdmissionController(AdmissionConfig(ewma_alpha=0.5))
+    ctl.note_batch(2, 0.2)  # 0.1 s/item
+    ctl.note_batch(1, 0.3)  # ewma -> 0.5*0.3 + 0.5*0.1 = 0.2
+    ctl.admit(None)
+    assert ctl.projected_wait_s() == pytest.approx(0.2)
+
+
+# -------------------------------------------------------- batcher units
+
+
+def _gated_batcher(**kw):
+    """A batcher whose worker parks inside the batch until released —
+    the deterministic way to hold requests IN the queue."""
+    gate, entered = threading.Event(), threading.Event()
+
+    def handler(queries, enq):
+        entered.set()
+        if not gate.wait(30):
+            raise RuntimeError("test gate never released")
+        return list(queries)
+
+    return RequestBatcher(handler, max_wait_s=0.0, **kw), gate, entered
+
+
+def test_batcher_completes_and_counts():
+    b = RequestBatcher(lambda qs, enq: [q * 2 for q in qs], max_batch=4)
+    try:
+        futs = [b.submit(i) for i in range(5)]
+        assert [f.result(30) for f in futs] == [0, 2, 4, 6, 8]
+        assert b.completed == 5 and b.submitted == 5
+    finally:
+        b.close()
+
+
+def test_batcher_short_answer_list_fails_unmatched_futures():
+    # the exactly-once audit: a handler dropping answers must FAIL the
+    # unmatched Futures (the old zip() silently left them hanging)
+    b = RequestBatcher(lambda qs, enq: [q for q in qs][:1],
+                       max_batch=4, max_wait_s=0.01)
+    try:
+        futs = [b.submit(i) for i in range(3)]
+        assert futs[0].result(30) == 0
+        for f in futs[1:]:
+            with pytest.raises(RuntimeError, match="answers for"):
+                f.result(30)
+    finally:
+        b.close()
+
+
+def test_batcher_handler_exception_fails_all_once():
+    def boom(queries, enq):
+        raise ValueError("device fell over")
+
+    b = RequestBatcher(boom, max_batch=4, max_wait_s=0.01)
+    try:
+        futs = [b.submit(i) for i in range(3)]
+        for f in futs:
+            with pytest.raises(ValueError, match="device fell over"):
+                f.result(30)
+        assert b.completed == 0
+    finally:
+        b.close()
+
+
+def test_batcher_pre_expired_deadline_sheds_at_submit():
+    b = RequestBatcher(lambda qs, enq: list(qs), max_batch=2)
+    try:
+        with pytest.raises(ShedError):
+            b.submit("x", deadline=Deadline.after(-1.0))
+        # normal traffic is unharmed
+        assert b.submit("y").result(30) == "y"
+    finally:
+        b.close()
+
+
+def test_batcher_in_queue_expiry_is_typed():
+    b, gate, entered = _gated_batcher(max_batch=1)
+    try:
+        first = b.submit("a")
+        assert entered.wait(30)
+        # queued behind the parked batch with a budget that lapses while
+        # the worker is provably inside the blocked batch
+        deadline = Deadline.after(0.02)
+        late = b.submit("b", deadline=deadline)
+        import time
+        while not deadline.expired():
+            time.sleep(0.001)
+        gate.set()
+        assert first.result(30) == "a"
+        with pytest.raises(DeadlineExceeded):
+            late.result(30)
+        assert b.expired == 1 and b.admission.expired == 1
+    finally:
+        gate.set()
+        b.close()
+
+
+def test_batcher_bounded_queue_sheds_with_retry_after():
+    b, gate, entered = _gated_batcher(max_batch=1, max_queue=1)
+    try:
+        first = b.submit("a")
+        assert entered.wait(30)
+        queued = b.submit("b")
+        with pytest.raises(ShedError) as ei:
+            b.submit("c")
+        assert ei.value.reason == "queue_full"
+        assert ei.value.retry_after_s >= 0.05
+        gate.set()
+        assert [first.result(30), queued.result(30)] == ["a", "b"]
+    finally:
+        gate.set()
+        b.close()
+
+
+def test_batcher_drain_then_typed_shed_then_close_runtimeerror():
+    b = RequestBatcher(lambda qs, enq: list(qs), max_batch=2)
+    futs = [b.submit(i) for i in range(3)]
+    report = b.drain(grace_s=30.0)
+    assert report["stuck"] is False
+    assert all(f.result(0) == i for i, f in enumerate(futs))
+    # post-drain submits shed with a TYPED error (clients can retry
+    # against a replacement replica) ...
+    with pytest.raises(ShedError) as ei:
+        b.submit("late")
+    assert ei.value.reason == "draining"
+    # ... while a plain close() keeps the legacy contract
+    b2 = RequestBatcher(lambda qs, enq: list(qs))
+    b2.close()
+    with pytest.raises(RuntimeError, match="closed"):
+        b2.submit("x")
+
+
+# -------------------------------------------------- fault classification
+
+
+def test_classify_fault_vocabulary():
+    from fks_tpu.resilience import DeviceFault, EngineBuildError, NaNFlood
+
+    class XlaRuntimeError(Exception):  # name is what classification sees
+        pass
+
+    assert classify_fault(DeviceFault("lost")) == "device_fault"
+    assert classify_fault(NaNFlood("flood")) == "nan_flood"
+    assert classify_fault(EngineBuildError("bad build")) == "engine_build"
+    assert classify_fault(XlaRuntimeError("dead device")) == "xla_runtime"
+    assert classify_fault(ValueError("not a device fault")) is None
+
+
+# ------------------------------------------------- serving stack (shared)
+
+
+@pytest.fixture(scope="module")
+def stack():
+    """One warm incumbent + exact fallback for the degrade/drain tests."""
+    import dataclasses
+
+    from fks_tpu.data.synthetic import synthetic_workload
+    from fks_tpu.funsearch import template
+    from fks_tpu.serve import ChampionSpec, ServeEngine, ShapeEnvelope
+
+    wl = synthetic_workload(8, 16, seed=0)
+    champ = ChampionSpec(code=template.fill_template("score = 1000"),
+                         score=0.5, source="<test-seed>")
+    env = ShapeEnvelope(max_pods=8, min_pod_bucket=8, max_batch=2)
+    incumbent = ServeEngine(champ, wl, envelope=env, engine="flat")
+    incumbent.warmup()
+    fallback = ServeEngine(champ, wl,
+                           envelope=dataclasses.replace(env, max_batch=1),
+                           engine="exact")
+    fallback.warmup()
+    return {"incumbent": incumbent, "fallback": fallback, "workload": wl}
+
+
+def _pods(stack, i, n=3):
+    base = stack["incumbent"].base_pods
+    return [dict(base[(i + j) % len(base)]) for j in range(n)]
+
+
+def test_degraded_flip_serves_same_batch_with_zero_drift(stack):
+    from fks_tpu.pipeline.faults import FlakyEngineProxy
+    from fks_tpu.serve import ServeService
+
+    flaky = FlakyEngineProxy(stack["incumbent"], failures=1)
+    service = ServeService(flaky, max_wait_s=0.002)
+    service.enable_degraded_mode(
+        lambda: stack["fallback"],
+        config=DegradeConfig(background_rebuild=False))
+    try:
+        pods = _pods(stack, 0)
+        ans = service.submit({"pods": [dict(p) for p in pods]}).result(300)
+        ref = stack["incumbent"].reference_answer(pods)
+        assert abs(ans["score"] - ref["score"]) == 0.0
+        hz = service.degrade.healthz()
+        assert hz == {"state": "degraded", "flips": 1, "recoveries": 0,
+                      "last_fault": "device_fault"}
+        assert service.engine is stack["fallback"]
+        assert service.healthz()["engine_state"] == "degraded"
+    finally:
+        service.close()
+
+
+def test_degraded_recovery_through_probation(stack):
+    from fks_tpu.pipeline.faults import FlakyEngineProxy
+    from fks_tpu.serve import ServeService
+
+    flaky = FlakyEngineProxy(stack["incumbent"], failures=1)
+    service = ServeService(flaky, max_wait_s=0.002)
+    mgr = service.enable_degraded_mode(
+        lambda: stack["fallback"],
+        rebuild_factory=lambda: stack["incumbent"],
+        config=DegradeConfig(probation_requests=1,
+                             background_rebuild=False))
+    try:
+        for i in range(4):
+            service.submit({"pods": _pods(stack, i)}).result(300)
+        hz = mgr.healthz()
+        assert hz["state"] == "normal" and hz["recoveries"] == 1
+        assert service.engine is stack["incumbent"]
+    finally:
+        service.close()
+
+
+def test_unclassified_exception_still_raises(stack):
+    from fks_tpu.serve import ServeService
+
+    class Broken:
+        def __getattr__(self, name):
+            return getattr(stack["incumbent"], name)
+
+        def answer_batch(self, queries):
+            raise ValueError("a plain bug, not a device fault")
+
+    service = ServeService(Broken(), max_wait_s=0.002)
+    service.enable_degraded_mode(
+        lambda: stack["fallback"],
+        config=DegradeConfig(background_rebuild=False))
+    try:
+        fut = service.submit({"pods": _pods(stack, 0)})
+        with pytest.raises(ValueError, match="plain bug"):
+            fut.result(300)
+        assert service.degrade.healthz()["state"] == "normal"
+    finally:
+        service.close()
+
+
+# --------------------------------------------------------- drain + state
+
+
+def test_real_sigterm_drains_and_persists(stack, tmp_path):
+    from fks_tpu.serve import ServeService
+
+    service = ServeService(stack["incumbent"], max_wait_s=0.002)
+    state_path = str(tmp_path / "serve_state.json")
+    dc = DrainCoordinator(service, state_path=state_path, grace_s=30.0)
+    assert dc.install()  # main test thread
+    try:
+        futs = [service.submit({"pods": _pods(stack, i)}) for i in range(3)]
+        os.kill(os.getpid(), signal.SIGTERM)
+        # the Python-level handler runs at the next bytecode boundary of
+        # this (main) thread; the loop body is that boundary
+        import time
+        t0 = time.monotonic()
+        while dc.report is None:
+            assert time.monotonic() - t0 < 30, "SIGTERM handler never ran"
+        assert all(f.done() for f in futs)
+        assert dc.report["stuck"] is False
+        state = load_serve_state(state_path)
+        assert state["requests_served"] >= 3
+        assert len(state["replay"]) >= 3
+    finally:
+        dc.uninstall()
+
+    # a fresh replica preloads the persisted replay buffer
+    service2 = ServeService(stack["incumbent"], max_wait_s=0.002)
+    try:
+        assert service2.preload_replay(state["replay"]) == len(state["replay"])
+    finally:
+        service2.close()
+
+
+def test_load_serve_state_refuses_torn_file(tmp_path):
+    torn = tmp_path / "state.json"
+    torn.write_text('{"version": 1, "replay": [')
+    with pytest.raises(ValueError):
+        load_serve_state(str(torn))
+    torn.write_text(json.dumps({"version": 99, "replay": []}))
+    with pytest.raises(ValueError):
+        load_serve_state(str(torn))
+
+
+# ---------------------------------------------------------- WAL + resume
+
+
+def test_wal_round_trip_commit_and_views(tmp_path):
+    path = str(tmp_path / "wal.jsonl")
+    wal = GenerationWAL(path)
+
+    class Rec:
+        code, score, error = "score = 1", 0.5, None
+        scenario_scores, aggregation, budget_rung = None, None, None
+
+    wal.record_codes(3, ["score = 1", "score = 2"])
+    wal.record_eval(3, Rec())
+    assert wal.pending_codes(3) == ["score = 1", "score = 2"]
+    assert set(wal.cached_evals(3)) == {GenerationWAL.code_key("score = 1")}
+    wal.commit(3)
+    assert wal.committed(3)
+    assert wal.pending_codes(3) is None and wal.cached_evals(3) == {}
+    # a reopened WAL sees the same committed state (fsync'd)
+    wal2 = GenerationWAL(path)
+    assert wal2.committed(3) and wal2.summary()["generations"] == [3]
+
+
+def test_wal_torn_tail_skipped_and_repaired(tmp_path):
+    path = str(tmp_path / "wal.jsonl")
+    wal = GenerationWAL(path)
+    wal.record_codes(1, ["score = 1"])
+    with open(path, "a") as f:
+        f.write('{"kind": "eval", "generation": 1, "ke')  # kill mid-write
+    wal2 = GenerationWAL(path)
+    assert wal2.skipped_lines == 1
+    assert wal2.pending_codes(1) == ["score = 1"]
+    wal2.commit(1)  # the repaired append stays its own parseable line
+    assert GenerationWAL(path).committed(1)
+
+
+def test_wal_resume_spends_zero_llm_calls(tmp_path):
+    from fks_tpu.funsearch import EvolutionConfig
+    from fks_tpu.funsearch import evolution as evo
+    from fks_tpu.pipeline.faults import CountingBackend, KillSwitch
+    from tests.test_engine_micro import micro_workload
+
+    wl = micro_workload()
+    ck, wal = str(tmp_path / "evo.json"), str(tmp_path / "wal.jsonl")
+
+    def cfg():
+        return EvolutionConfig(population_size=4, generations=2,
+                               elite_size=2, candidates_per_generation=2,
+                               max_workers=1, seed=3)
+
+    fired = {}
+
+    def kill_mid_gen2(stats):
+        if stats.generation == 2 and not fired:
+            fired["x"] = True
+            raise KillSwitch("injected kill mid-generation")
+
+    backend = CountingBackend(seed=3)
+    with pytest.raises(KillSwitch):
+        evo.run(wl, cfg(), backend=backend, checkpoint_path=ck,
+                wal_path=wal, on_generation=kill_mid_gen2,
+                log=lambda _m: None)
+    assert backend.calls > 0
+
+    backend2 = CountingBackend(seed=3)
+    fs = evo.run(wl, cfg(), backend=backend2, checkpoint_path=ck,
+                 wal_path=wal, log=lambda _m: None)
+    assert backend2.calls == 0  # the whole point of the WAL
+    assert fs.wal_replayed_codes > 0 and fs.wal_replayed_evals > 0
+    assert fs.evaluator.compile_count == 0
+    assert fs.generation == 2 and fs.best is not None
+    assert GenerationWAL(wal).committed(2)
+
+    # the SAME run replayed deterministically matches an uninterrupted one
+    ck2, wal2 = str(tmp_path / "evo2.json"), str(tmp_path / "wal2.jsonl")
+    fs_ref = evo.run(wl, cfg(), backend=CountingBackend(seed=3),
+                     checkpoint_path=ck2, wal_path=wal2,
+                     log=lambda _m: None)
+    assert fs.best == fs_ref.best
+    assert sorted(fs.population) == sorted(fs_ref.population)
+
+
+def test_torn_checkpoint_refused(tmp_path):
+    from fks_tpu.funsearch import (
+        CodeEvaluator, EvolutionConfig, FakeLLM, FunSearch,
+    )
+    from tests.test_engine_micro import micro_workload
+
+    fs = FunSearch(CodeEvaluator(micro_workload()),
+                   EvolutionConfig(population_size=4, max_workers=1),
+                   backend=FakeLLM(seed=1), log=lambda _m: None)
+    torn = tmp_path / "evo.json"
+    torn.write_text('{"version": 1, "generation": 2, "popul')
+    with pytest.raises(ValueError, match="torn checkpoint"):
+        fs.restore(str(torn))
+
+
+# ------------------------------------------------------ schema vocabulary
+
+
+def test_schema_enforces_new_resilience_kinds(tmp_path):
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "tools"))
+    try:
+        import check_jsonl_schema as cjs
+    finally:
+        sys.path.pop(0)
+
+    good = [
+        {"ts": 1, "kind": "shed", "reason": "queue_full",
+         "queue_depth": 2, "retry_after_s": 0.05},
+        {"ts": 2, "kind": "degraded", "fault": "xla_runtime",
+         "state": "degraded"},
+        {"ts": 3, "kind": "drain", "pending": 2, "completed": 2},
+        {"ts": 4, "kind": "resume_wal", "generation": 2},
+    ]
+    p = tmp_path / "events.jsonl"
+    p.write_text("".join(json.dumps(r) + "\n" for r in good))
+    records = cjs.check_jsonl(str(p), required=("ts", "kind"))
+    cjs.check_kinds(str(p), records, cjs.EVENT_KIND_REQUIRED)
+
+    for rec, key in ((good[0], "reason"), (good[1], "state"),
+                     (good[2], "pending"), (good[3], "generation")):
+        bad = dict(rec)
+        del bad[key]
+        p.write_text(json.dumps(bad) + "\n")
+        records = cjs.check_jsonl(str(p), required=("ts", "kind"))
+        with pytest.raises(cjs.SchemaError, match=key):
+            cjs.check_kinds(str(p), records, cjs.EVENT_KIND_REQUIRED)

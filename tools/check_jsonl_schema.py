@@ -71,6 +71,17 @@ EVENT_KIND_REQUIRED: Dict[str, Tuple[str, ...]] = {
     # evolve circuit breaker: N consecutive all-failed-LLM generations
     # tripped the loop (cli evolve exits 4 after checkpointing)
     "llm_outage": ("generation", "consecutive"),
+    # resilience layer (fks_tpu.resilience): admission control refused a
+    # request (reason: queue_full / deadline_budget / draining)
+    "shed": ("reason",),
+    # degraded-mode state machine transition (state: degraded /
+    # probation / normal / dead)
+    "degraded": ("fault", "state"),
+    # SIGTERM drain completed: every in-flight Future completed or shed
+    "drain": ("pending",),
+    # evolve WAL replay: a resumed generation reused persisted
+    # candidates/evals instead of re-spending LLM calls / device evals
+    "resume_wal": ("generation",),
 }
 
 #: legal ``taxonomy`` values on a candidate_rejected event. This tool is

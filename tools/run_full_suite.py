@@ -44,6 +44,15 @@ throughput drop must raise EXACTLY one alert (exit 1 under
 none (exit 0). A miss either way means the robust-z change-point pass
 is broken — its alerts on the real archive would be noise or silence.
 Recorded as ``trends_gate``. Pure-host (no jax import needed).
+
+A RESILIENCE GATE follows: the deterministic resilience drills
+(deadline storm, queue overload, device loss mid-batch,
+degrade-then-recover, SIGTERM drain, WAL resume mid-generation) from
+``fks_tpu/resilience/drills.py`` must all pass via
+``cli pipeline --drill --only <resilience drills>`` (exit 0). A failure
+means the shed/degrade/drain/WAL machinery the serve and evolve loops
+lean on under faults no longer holds its invariants. Recorded as
+``resilience_gate``.
 """
 from __future__ import annotations
 
@@ -159,6 +168,26 @@ def promote_gate() -> dict:
     return {"ok": ok, **detail}
 
 
+def resilience_gate() -> dict:
+    """Resilience-drill matrix: the deterministic failure drills from
+    fks_tpu/resilience/drills.py (deadline storm, queue overload, device
+    loss mid-batch, degrade-then-recover, SIGTERM drain, WAL resume) must
+    pass — ``cli pipeline --drill --only <resilience>`` exits 0.
+    Returns {"ok": bool, ...}."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    only = ("deadline_storm,queue_overload,device_loss,degrade,"
+            "sigterm,wal_resume")
+    proc = subprocess.run(
+        [sys.executable, "-m", "fks_tpu.cli", "pipeline", "--cpu",
+         "--drill", "--only", only],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=900)
+    ok = proc.returncode == 0
+    detail = {"rc": proc.returncode}
+    if not ok:
+        detail["err"] = (proc.stderr or proc.stdout or "")[-500:]
+    return {"ok": ok, **detail}
+
+
 def _write_history(root: str, values) -> None:
     now = time.time()
     for i, v in enumerate(values):
@@ -226,6 +255,9 @@ def main() -> int:
     pgate = promote_gate()
     if not pgate["ok"]:
         print(f"PROMOTE GATE FAILED: {pgate}", file=sys.stderr)
+    rgate = resilience_gate()
+    if not rgate["ok"]:
+        print(f"RESILIENCE GATE FAILED: {rgate}", file=sys.stderr)
     t0 = time.time()
     proc = subprocess.run(
         [sys.executable, "-m", "pytest", "tests/", "-q",
@@ -237,13 +269,15 @@ def main() -> int:
     counts = {k: int(v) for v, k in re.findall(
         r"(\d+) (passed|failed|error|skipped|deselected|xfailed)", summary)}
     gates_ok = (gate["ok"] and tgate["ok"] and sgate["ok"] and vgate["ok"]
-                and lgate["ok"] and ngate["ok"] and pgate["ok"])
+                and lgate["ok"] and ngate["ok"] and pgate["ok"]
+                and rgate["ok"])
     rc = proc.returncode if gates_ok else (proc.returncode or 1)
     row = {"ts": round(time.time(), 1), "rev": rev, "rc": rc,
            "wall_s": wall, **counts, "obs_gate": gate,
            "trace_gate": tgate, "scale_gate": sgate, "serve_gate": vgate,
            "lint_gate": lgate, "trends_gate": ngate,
-           "promote_gate": pgate, "summary": summary}
+           "promote_gate": pgate, "resilience_gate": rgate,
+           "summary": summary}
     os.makedirs(os.path.dirname(OUT), exist_ok=True)
     with open(OUT, "a") as f:
         f.write(json.dumps(row) + "\n")
