@@ -1134,7 +1134,17 @@ def stage_serve(gate: str = "") -> int:
       coalescer's payoff at full occupancy;
     - ``steady_state_recompiles``: backend compiles observed during the
       warm passes — the zero-recompile contract, gated at 0 here.
+
+    ``--devices N`` (or FKS_BENCH_SERVE_DEVICES) switches to the
+    mesh-sharded occupancy sweep (``stage_serve_sharded``): same champion
+    and cluster, the batch axis sharded across N virtual CPU devices.
     """
+    devices = 0
+    if "--devices" in sys.argv:
+        devices = int(sys.argv[sys.argv.index("--devices") + 1])
+    devices = devices or int(os.environ.get("FKS_BENCH_SERVE_DEVICES", "0"))
+    if devices:
+        return stage_serve_sharded(gate, devices)
     import jax
     jax.config.update("jax_platforms", "cpu")
     import numpy as np
@@ -1224,12 +1234,174 @@ def stage_serve(gate: str = "") -> int:
         "node_prefilter_k": engine.prefilter_k,
         "champion_score": round(champion.score, 4),
     }
+    # snapshot-cache + upload accounting (new in round 17; additive keys,
+    # so prior-round compare baselines are unaffected)
+    cache = engine.snapshot_cache_stats()
+    payload["snapshot_cache_hit_rate"] = round(cache["hit_rate"], 4)
+    payload["serve_h2d_bytes_per_query"] = round(
+        cache["h2d_bytes_per_query"], 1)
     _record("metric", "bench_stage", payload, stage="serve",
             platform="cpu")
+    _record("metric", "snapshot_cache", dict(cache))
     rc = 0
     if recompiles:
         log(f"FAIL: {recompiles} recompiles on the warm path — a bucket "
             "shape leaked out of the AOT cache")
+        rc = 1
+    if gate:
+        rc = rc or _gate(gate, payload)
+    _record("finish", "ok" if rc == 0 else "fail")
+    _record("close")
+    print(json.dumps(payload))
+    return rc
+
+
+def stage_serve_sharded(gate: str, devices: int) -> int:
+    """CPU subprocess: mesh-sharded serving occupancy sweep — the round-17
+    headline. The coalesced batch axis is sharded across ``devices``
+    virtual CPU devices (one AOT executable spans the mesh), cluster
+    snapshot tables are device-resident behind the content-hash cache,
+    and query uploads ride the 16-bit ``state_pack`` path. Measures, at
+    equal PER-DEVICE batch sizes 1/8/64:
+
+    - ``serve_sharded_qps``: best global answers/sec over the sweep (the
+      cross-round comparable; ``serve_qps_b{n}`` is the per-device-batch
+      breakdown, global batch = n x devices);
+    - ``serve_p50_ms`` / ``serve_p99_ms``: warm latency of a per-device
+      batch-1 dispatch (``devices`` queries per answer_batch);
+    - ``serve_h2d_bytes_per_query`` + ``h2d_seconds``/``steady_seconds``:
+      upload-vs-execute attribution (StageProfiler h2d/steady stages);
+    - ``snapshot_cache_hit_rate``: device-resident ktable reuse;
+    - ``steady_state_recompiles``: gated at 0, same contract as the
+      single-device stage.
+    """
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    if devices > 1:
+        try:
+            jax.config.update("jax_num_cpu_devices", devices)
+        except AttributeError:
+            # jax 0.4.x: virtual host-device count is an XLA flag, read
+            # when the (cleared) backend initializes
+            flags = os.environ.get("XLA_FLAGS", "")
+            if "xla_force_host_platform_device_count" not in flags:
+                os.environ["XLA_FLAGS"] = (
+                    f"{flags} --xla_force_host_platform_device_count="
+                    f"{devices}").strip()
+            from jax.extend import backend as _jexb
+            _jexb.clear_backends()
+    import numpy as np
+
+    from fks_tpu.data.synthetic import synthetic_workload
+    from fks_tpu.funsearch import template
+    from fks_tpu.obs import CompileWatcher, StageProfiler
+    from fks_tpu.parallel.mesh import population_mesh
+    from fks_tpu.serve import (
+        ChampionSpec, ServeEngine, ShapeEnvelope, latest_champion,
+        load_champion,
+    )
+
+    global _RECORDER
+    _RECORDER = _controller_recorder()
+    watcher = CompileWatcher().install()
+    if len(jax.devices()) < devices:
+        log(f"FAIL: need {devices} devices, backend has "
+            f"{len(jax.devices())}")
+        return 1
+    mesh = population_mesh(jax.devices()[:devices])
+    nodes = int(os.environ.get("FKS_BENCH_SERVE_NODES", "32"))
+    qpods = int(os.environ.get("FKS_BENCH_SERVE_PODS", "24"))
+    reps = int(os.environ.get("FKS_BENCH_SERVE_REPS", "20"))
+    batches = (1, 8, 64)  # per-device coalesced batch sizes
+
+    champ_path = latest_champion()
+    champion = (load_champion(champ_path) if champ_path else
+                ChampionSpec(code=template.fill_template("score = 1000")))
+    bucket = max(32, qpods)
+    envelope = ShapeEnvelope(max_pods=bucket, min_pod_bucket=bucket,
+                             max_batch=max(batches))
+    wl = synthetic_workload(nodes, 4 * qpods, seed=7)
+    profiler = StageProfiler(scope="serve_sharded", watcher=watcher)
+    engine = ServeEngine(champion, wl, envelope=envelope, engine="flat",
+                         state_pack=True, mesh=mesh, profiler=profiler)
+    base = engine.base_pods
+    n_q = max(batches) * devices
+    queries = [[dict(base[(i + j) % len(base)]) for j in range(qpods)]
+               for i in range(n_q)]
+    log(f"serve sharded stage: {devices} devices, {nodes} nodes, "
+        f"{qpods}-pod queries, per-device batches {batches}, champion "
+        f"score={champion.score:.4f} tier={engine.policy_tier}")
+
+    # cold: first per-device-batch-1 answer, compile included
+    t0 = time.perf_counter()
+    engine.answer_batch(queries[:devices])
+    cold_s = time.perf_counter() - t0
+    engine.warmup(lane_buckets=[engine.envelope.lanes_for(b)
+                                for b in batches])
+    for b in batches:  # prime host-side stacking per global batch shape
+        engine.answer_batch(queries[:b * devices])
+    compiles_warm = watcher.backend_compile_count
+
+    # warm latency at per-device batch 1 (devices queries per dispatch)
+    lat_ms = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        engine.answer_batch(queries[:devices])
+        lat_ms.append((time.perf_counter() - t0) * 1e3)
+    p50 = float(np.percentile(lat_ms, 50))
+    p99 = float(np.percentile(lat_ms, 99))
+
+    # occupancy sweep: global throughput per per-device batch size
+    qps = {}
+    for b in batches:
+        n_rounds = max(1, reps // 4)
+        t0 = time.perf_counter()
+        for _ in range(n_rounds):
+            engine.answer_batch(queries[:b * devices])
+        qps[b] = b * devices * n_rounds / (time.perf_counter() - t0)
+    recompiles = watcher.backend_compile_count - compiles_warm
+
+    summ = profiler.summary()
+    by_stage = {s["stage"]: s for s in summ["stages"]}
+    h2d_s = float(by_stage.get("h2d", {}).get("wall_seconds", 0.0))
+    steady_s = float(by_stage.get("steady", {}).get("wall_seconds", 0.0))
+    cache = engine.snapshot_cache_stats()
+    log("occupancy sweep (per-device batch -> global qps):")
+    for b in batches:
+        log(f"  b{b:<3} x {devices} dev = {b * devices:>4} q/chunk  "
+            f"{qps[b]:10.1f} qps")
+    log(f"cold {cold_s:.2f}s; warm p50 {p50:.1f}ms p99 {p99:.1f}ms; "
+        f"h2d {h2d_s:.3f}s steady {steady_s:.3f}s; cache hit rate "
+        f"{cache['hit_rate']:.2f}; recompiles in warm passes: {recompiles}")
+
+    payload = {
+        "devices": devices,
+        "serve_sharded_qps": round(max(qps.values()), 2),
+        "serve_cold_seconds": round(cold_s, 3),
+        "serve_p50_ms": round(p50, 3),
+        "serve_p99_ms": round(p99, 3),
+        **{f"serve_qps_b{b}": round(v, 2) for b, v in qps.items()},
+        "serve_h2d_bytes_per_query": round(
+            cache["h2d_bytes_per_query"], 1),
+        "h2d_seconds": round(h2d_s, 3),
+        "steady_seconds": round(steady_s, 3),
+        "snapshot_cache_hit_rate": round(cache["hit_rate"], 4),
+        "snapshot_cache_hits": int(cache["hits"]),
+        "snapshot_cache_misses": int(cache["misses"]),
+        "steady_state_recompiles": recompiles,
+        "backend_compiles": watcher.backend_compile_count,
+        "nodes": nodes, "query_pods": qpods, "reps": reps,
+        "engine": "flat", "state_pack": True,
+        "policy_tier": engine.policy_tier,
+        "champion_score": round(champion.score, 4),
+    }
+    _record("metric", "bench_stage", payload, stage="serve_sharded",
+            platform="cpu")
+    _record("metric", "snapshot_cache", dict(cache))
+    rc = 0
+    if recompiles:
+        log(f"FAIL: {recompiles} recompiles on the warm path — a bucket "
+            "shape leaked out of the sharded AOT cache")
         rc = 1
     if gate:
         rc = rc or _gate(gate, payload)

@@ -56,40 +56,59 @@ def _apply_platform_flags(args):
         if n_dev:
             # must precede first backend init (same constraint as
             # __graft_entry__.dryrun_multichip)
-            jax.config.update("jax_num_cpu_devices", n_dev)
+            legacy_xla = False
+            try:
+                jax.config.update("jax_num_cpu_devices", n_dev)
+            except AttributeError:
+                legacy_xla = True
+                # jax 0.4.x has no jax_num_cpu_devices; the virtual
+                # host-platform device count is an XLA flag there, read
+                # when the (cleared) backend initializes — the same
+                # fallback dryrun_multichip uses
+                import os
+                flags = os.environ.get("XLA_FLAGS", "")
+                if "xla_force_host_platform_device_count" not in flags:
+                    os.environ["XLA_FLAGS"] = (
+                        f"{flags} --xla_force_host_platform_device_count"
+                        f"={n_dev}").strip()
+                from jax.extend import backend as _jexb
+                _jexb.clear_backends()
             # n virtual device programs time-slicing few host cores skew
             # their arrival at collectives far past XLA-CPU's default
             # terminate timeout (observed: the 100k-pod mesh run died in
             # rendezvous on a 1-core container until these were raised;
             # README "Synthetic scale"). XLA_FLAGS is read at backend
-            # creation, so appending here is still in time.
+            # creation, so appending here is still in time. The legacy
+            # (jax 0.4.x) XLA predates these flags and aborts on unknown
+            # XLA_FLAGS tokens, so skip them there.
             import os
             import sys
-            tokens = os.environ.get("XLA_FLAGS", "").split()
-            names = {t.split("=")[0] for t in tokens}
-            for f in ("--xla_cpu_collective_timeout_seconds=7200",
-                      "--xla_cpu_collective_call_terminate_timeout_seconds"
-                      "=7200"):
-                name = f.split("=")[0]
-                # token-boundary match, not substring: a user-set value for
-                # the SAME flag is honored (warn, since 40 s defaults hang
-                # the 100k-pod mesh run), and an unrelated flag sharing a
-                # prefix can't mask ours
-                if name in names:
-                    if f not in tokens:
-                        print(f"fks_tpu: honoring existing {name} from "
-                              "XLA_FLAGS", file=sys.stderr)
-                    continue
-                tokens.append(f)
-            try:  # private probe; best-effort warning only
-                initialized = bool(jax._src.xla_bridge._backends)
-            except AttributeError:
-                initialized = False
-            if initialized:  # appended too late to apply
-                print("fks_tpu: JAX backends already initialized; XLA_FLAGS "
-                      "collective timeouts will not take effect this run",
-                      file=sys.stderr)
-            os.environ["XLA_FLAGS"] = " ".join(tokens)
+            if not legacy_xla:
+                tokens = os.environ.get("XLA_FLAGS", "").split()
+                names = {t.split("=")[0] for t in tokens}
+                for f in ("--xla_cpu_collective_timeout_seconds=7200",
+                          "--xla_cpu_collective_call_terminate_timeout_seconds"
+                          "=7200"):
+                    name = f.split("=")[0]
+                    # token-boundary match, not substring: a user-set value
+                    # for the SAME flag is honored (warn, since 40 s defaults
+                    # hang the 100k-pod mesh run), and an unrelated flag
+                    # sharing a prefix can't mask ours
+                    if name in names:
+                        if f not in tokens:
+                            print(f"fks_tpu: honoring existing {name} from "
+                                  "XLA_FLAGS", file=sys.stderr)
+                        continue
+                    tokens.append(f)
+                try:  # private probe; best-effort warning only
+                    initialized = bool(jax._src.xla_bridge._backends)
+                except AttributeError:
+                    initialized = False
+                if initialized:  # appended too late to apply
+                    print("fks_tpu: JAX backends already initialized; "
+                          "XLA_FLAGS collective timeouts will not take "
+                          "effect this run", file=sys.stderr)
+                os.environ["XLA_FLAGS"] = " ".join(tokens)
     if getattr(args, "f64", False):
         jax.config.update("jax_enable_x64", True)
 
@@ -583,11 +602,18 @@ def cmd_serve(args):
     with _flight_recorder(args, "serve") as rec, obs.watch_compiles(rec):
         import os as _os
         from fks_tpu.serve.artifact import CHAMPION_DIR
+        mesh = None
+        if getattr(args, "devices", 0):
+            # mesh-sharded serving: the platform flags above already
+            # sized the virtual CPU mesh; shard the lane axis over it
+            import jax
+            from fks_tpu.parallel import population_mesh
+            mesh = population_mesh(jax.devices()[:args.devices])
         ledger_dir = args.ledger_dir or CHAMPION_DIR
         promotion_log = (args.promotion_log
                          or _os.path.join(ledger_dir, "promotion.jsonl"))
         if args.artifact:
-            engine = ServeEngine.load(args.artifact, recorder=rec)
+            engine = ServeEngine.load(args.artifact, recorder=rec, mesh=mesh)
         else:
             champ_path = args.champion
             if not champ_path and args.follow_ledger:
@@ -616,7 +642,7 @@ def cmd_serve(args):
                 engine=args.engine,
                 prefilter_k=getattr(args, "prefilter_k", None),
                 state_pack=getattr(args, "state_pack", False),
-                recorder=rec)
+                mesh=mesh, recorder=rec)
         if rec.enabled:
             rec.annotate_meta(
                 engine=engine.engine_name,
@@ -637,6 +663,8 @@ def cmd_serve(args):
             result = selftest(engine, count=args.selftest,
                               pods_per_query=args.pods_per_query,
                               tol=args.audit_tol)
+            if rec.enabled and "snapshot_cache" in result:
+                rec.metric("snapshot_cache", **result["snapshot_cache"])
             print(json.dumps(result, indent=2))
             return 0 if result["ok"] else 1
         if args.warmup and not args.save_artifact:
@@ -1288,7 +1316,16 @@ def main(argv=None) -> int:
                     help="SimConfig.node_prefilter_k override (default: "
                          "auto via the policy-cost probe)")
     sv.add_argument("--state-pack", action="store_true",
-                    help="SimConfig.state_pack for the serving engine")
+                    help="SimConfig.state_pack for the serving engine; "
+                         "also engages the 16-bit packed query-upload "
+                         "path (bit-identical answers, ~half the "
+                         "H2D bytes per request table)")
+    sv.add_argument("--devices", type=int, default=0,
+                    help="mesh-sharded serving: size a virtual CPU "
+                         "device mesh (requires --cpu) and shard the "
+                         "coalesced batch axis over it — one AOT "
+                         "executable per (lane, pod) bucket spans every "
+                         "device (0 = single-device engine)")
     sv.add_argument("--warmup", action="store_true",
                     help="pre-compile every (lane, pod) shape bucket "
                          "before answering")

@@ -65,6 +65,14 @@ DEFAULT_THRESHOLDS: Dict[str, Threshold] = {
     # at millisecond scale), and batched throughput must not drop >10%
     "serve_p99_ms": Threshold(higher_is_better=False, rel=0.25, abs_tol=2.0),
     "serve_qps": Threshold(higher_is_better=True, rel=0.10),
+    # mesh-sharded serving (bench stage_serve --devices): global
+    # throughput across the device mesh must not drop >10%, and the
+    # per-query upload volume (post-packing, snapshot-cache-discounted)
+    # must not regress — growth means packing broke or the cache stopped
+    # hitting (64-byte floor absorbs padding jitter at tiny shapes)
+    "serve_sharded_qps": Threshold(higher_is_better=True, rel=0.10),
+    "serve_h2d_bytes_per_query": Threshold(higher_is_better=False,
+                                           rel=0.0, abs_tol=64.0),
     # static pre-flight (bench stage_preflight): the fraction of the
     # candidate stream rejected before sandbox/transpile must not drop
     # more than 5 points — a drop means the analyzer stopped catching a
@@ -104,14 +112,16 @@ def _from_run_dir(run_dir: str) -> Dict[str, float]:
         for key in ("evals_per_sec", "code_evals_per_sec",
                     "budget_speedup", "budget_champion_match",
                     "scale1k_events_per_sec", "serve_qps",
-                    "preflight_reject_rate"):
+                    "serve_sharded_qps", "preflight_reject_rate"):
             v = _num(m.get(key))
             if v is not None:
                 out[key] = max(out.get(key, 0.0), v)
-        # latency: best (lowest) observation, mirroring serve_qps's max
-        v = _num(m.get("serve_p99_ms"))
-        if v is not None:
-            out["serve_p99_ms"] = min(out.get("serve_p99_ms", v), v)
+        # latency/upload volume: best (lowest) observation, mirroring
+        # serve_qps's max
+        for key in ("serve_p99_ms", "serve_h2d_bytes_per_query"):
+            v = _num(m.get(key))
+            if v is not None:
+                out[key] = min(out.get(key, v), v)
         v = _num(m.get("compile_seconds"))
         if v is not None:
             out["compile_seconds"] = out.get("compile_seconds", 0.0) + v
@@ -147,11 +157,13 @@ def _from_jsonl(path: str, allow_stale: bool = False) -> Dict[str, float]:
                     "compile_seconds", "best_score", "median_score",
                     "parity_max_drift", "budget_speedup",
                     "budget_champion_match", "scale1k_events_per_sec",
-                    "serve_p99_ms", "serve_qps", "preflight_reject_rate"):
+                    "serve_p99_ms", "serve_qps", "serve_sharded_qps",
+                    "serve_h2d_bytes_per_query", "preflight_reject_rate"):
             v = _num(rec.get(key))
             if v is None:
                 continue
-            if key in ("compile_seconds", "serve_p99_ms"):
+            if key in ("compile_seconds", "serve_p99_ms",
+                       "serve_h2d_bytes_per_query"):
                 out[key] = min(out.get(key, v), v)
             else:
                 out[key] = max(out.get(key, v), v)

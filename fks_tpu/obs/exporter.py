@@ -228,6 +228,30 @@ def to_openmetrics(run_dir: str) -> str:
                 0 if s.get("engine_state") == "normal" else 1,
                 run_id=run_id, state=str(s.get("engine_state")))
 
+    # device-resident snapshot cache (ServeEngine content-hash ktable
+    # cache): reuse vs upload economics of the sharded serve path
+    latest_cache = None
+    for c in (m for m in metrics if m.get("kind") == "snapshot_cache"):
+        latest_cache = c
+    if latest_cache is not None:
+        c = latest_cache
+        fam("serve_snapshot_cache_hits", "gauge",
+            "query batches whose padded ktable was already device-"
+            "resident").add(c.get("hits"), run_id=run_id)
+        fam("serve_snapshot_cache_misses", "gauge",
+            "query batches that uploaded a fresh ktable").add(
+            c.get("misses"), run_id=run_id)
+        fam("serve_snapshot_cache_entries", "gauge",
+            "device buffers currently held by the LRU cache").add(
+            c.get("entries"), run_id=run_id)
+        fam("serve_snapshot_cache_hit_rate", "gauge",
+            "hits / (hits + misses)").add(
+            c.get("hit_rate"), run_id=run_id)
+        fam("serve_h2d_bytes_per_query", "gauge",
+            "host-to-device bytes shipped per answered query "
+            "(post-packing, cache-discounted)").add(
+            c.get("h2d_bytes_per_query"), run_id=run_id)
+
     counts: Dict[str, int] = {}
     for e in events:
         kind = e.get("kind", "?")

@@ -38,7 +38,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from fks_tpu.data.entities import Workload
 from fks_tpu.models import parametric
-from fks_tpu.parallel.population import ParamPolicyFn
+from fks_tpu.parallel.population import ParamPolicyFn, lead_axis_size
 from fks_tpu.sim.engine import SimConfig, initial_state, make_population_run_fn
 from fks_tpu.utils.compat import shard_map
 from fks_tpu.utils.segments import segment_budget
@@ -145,7 +145,7 @@ def pad_population(params, num_shards):
     """
     if isinstance(num_shards, Mesh):
         num_shards = _num_shards(num_shards)
-    c = jax.tree_util.tree_leaves(params)[0].shape[0]
+    c = lead_axis_size(params)
     target = -(-c // num_shards) * num_shards
     if target != c:
         def _pad_leaf(x):
@@ -199,7 +199,7 @@ def shard_population(params, mesh: Mesh):
     (candidate) axis sharded over the mesh's pop axes. Identity layout for
     a bare ``jax.Array`` population — the historical fast path — and the
     generic entry for pytree payloads (stacked VM programs)."""
-    c = jax.tree_util.tree_leaves(params)[0].shape[0]
+    c = lead_axis_size(params)
     if c % _num_shards(mesh):
         raise ValueError(
             f"population {c} not divisible by shard count "
@@ -208,6 +208,50 @@ def shard_population(params, mesh: Mesh):
 
 
 _shard_params = shard_population  # internal alias, kept for call sites
+
+
+# -------------------------------------------------------- serve batch axis
+#
+# The serving tier (fks_tpu.serve) coalesces concurrent what-if queries
+# onto the SAME leading batch axis the population machinery shards — a
+# query lane is a one-candidate population. These three helpers are the
+# serve-side pad/shard specs, mirroring make_sharded_code_eval's layout so
+# one AOT executable per (lane, pod) bucket spans the whole mesh.
+
+
+def serve_lane_count(lane_bucket: int, mesh: Optional[Mesh] = None) -> int:
+    """Global lane count for a serve dispatch: the PER-DEVICE lane bucket
+    times the mesh's shard count (identity with no mesh). The serve engine
+    compiles one executable per (global_lanes, pod_bucket), so "equal
+    per-device batch" comparisons across mesh sizes share lane buckets;
+    remainder lanes inside the global count are ``pad_population``
+    duplicates, accounted by ``pad_stats``/``occupancy_stats``."""
+    if mesh is None:
+        return int(lane_bucket)
+    return int(lane_bucket) * _num_shards(mesh)
+
+
+def serve_sharding(mesh: Mesh) -> NamedSharding:
+    """The NamedSharding that places a leading lane/batch axis over the
+    mesh's pop axes — what serve uploads (query deltas, cached snapshot
+    tables, initial states) are ``device_put`` with, and what the AOT
+    executable's in_shardings are lowered from."""
+    return NamedSharding(mesh, P(_pop_axes(mesh)))
+
+
+def make_sharded_serve_fn(serve_fn, mesh: Mesh):
+    """Wrap a lane-batched serve pipeline ``(pods, ktable, state0) ->
+    SimResult`` in ``shard_map`` over the pop axes: every argument and
+    result pytree shards on its leading lane axis. The pipeline contains
+    NO collectives — each device drains its own lane chunk through its own
+    ``run_batched_lanes`` while_loop, so per-device trip counts are
+    independent and a short lane never stalls a long one across the mesh.
+    ``check_vma=False`` for the same engine-internal reason as the
+    population entry points (see NOTE above)."""
+    axes = _pop_axes(mesh)
+    return shard_map(serve_fn, mesh=mesh,
+                     in_specs=(P(axes), P(axes), P(axes)),
+                     out_specs=P(axes), check_vma=False)
 
 
 def _global_results(run, state0, params_shard, axes):

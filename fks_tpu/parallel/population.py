@@ -39,6 +39,14 @@ ParamPolicyFn = Callable[[jax.Array, PodView, NodeView], jax.Array]
 make_single_run = make_param_run_fn
 
 
+def lead_axis_size(tree) -> int:
+    """Leading-axis length of a batched pytree — the candidate count of a
+    population batch or the lane count of a coalesced serve batch. The
+    one definition shared by the mesh padder/sharder and the serve tier,
+    so "what is the batch axis" cannot drift between them."""
+    return jax.tree_util.tree_leaves(tree)[0].shape[0]
+
+
 def fused_runner(workload: Workload, param_policy, cfg: SimConfig,
                  lanes: int = 64, interpret: bool | None = None):
     """The ONE dispatch point for the fused Pallas engine (shared by the

@@ -13,6 +13,10 @@ no sleeps standing in for synchronization, no probabilities:
 - device_loss_mid_batch-> an injected device fault mid-batch flips the
                           service to the exact fallback and the SAME
                           batch is answered there, parity drift 0.0
+- device_loss_sharded_serve -> the same fault against the MESH-SHARDED
+                          engine (round-17 serve path) degrades to the
+                          single-device exact fallback with 0.0 drift
+                          and a live batcher (follow-up traffic answers)
 - degrade_then_recover -> fault -> fallback -> rebuilt primary ->
                           probation -> normal, with ZERO post-recovery
                           recompiles (the rebuild was a warm engine)
@@ -294,10 +298,58 @@ def _drill_wal_resume_mid_generation(stack) -> Dict[str, Any]:
                 "resume_device_programs": fs.evaluator.compile_count}
 
 
+def _drill_device_loss_sharded_serve(stack) -> Dict[str, Any]:
+    """Losing a mesh lane mid-batch on the SHARDED serve engine (the
+    round-17 mesh path: batch axis sharded over every visible device,
+    packed uploads, device-resident snapshot cache) degrades to the
+    single-device exact fallback — the same batch is answered there with
+    0.0 drift, and the batcher is NOT wedged: follow-up traffic on the
+    degraded service still completes."""
+    import jax
+
+    from fks_tpu.parallel.mesh import population_mesh
+    from fks_tpu.pipeline.faults import FlakyEngineProxy
+    from fks_tpu.serve import ServeService
+
+    if getattr(stack, "_resilience_sharded", None) is None:
+        from fks_tpu.serve import ServeEngine
+
+        eng = ServeEngine(stack.incumbent.champion, stack.workload,
+                          envelope=stack.envelope, engine="flat",
+                          state_pack=True,
+                          mesh=population_mesh(jax.devices()))
+        eng.warmup()
+        stack._resilience_sharded = eng
+    flaky = FlakyEngineProxy(stack._resilience_sharded, failures=1)
+    service = ServeService(flaky, max_wait_s=0.002)
+    service.enable_degraded_mode(
+        lambda: _fallback_engine(stack),
+        config=DegradeConfig(background_rebuild=False))
+    try:
+        drift = _degrade_traffic_parity(stack, service, 3)
+        follow_up = stack.traffic(service, 2)  # batcher still alive
+        degrade = service.degrade.healthz()
+        return {"ok": (flaky.faults_raised == 1
+                       and degrade["state"] == "degraded"
+                       and degrade["flips"] == 1
+                       and degrade["last_fault"] == "device_fault"
+                       and service.engine is _fallback_engine(stack)
+                       and drift == 0.0
+                       and len(follow_up) == 2
+                       and all("score" in a for a in follow_up)),
+                "state": degrade["state"], "flips": degrade["flips"],
+                "parity_drift": drift,
+                "mesh_devices": len(jax.devices()),
+                "follow_up_answers": len(follow_up)}
+    finally:
+        service.close()
+
+
 RESILIENCE_DRILLS = (
     _drill_deadline_storm,
     _drill_queue_overload,
     _drill_device_loss_mid_batch,
+    _drill_device_loss_sharded_serve,
     _drill_degrade_then_recover,
     _drill_sigterm_drain,
     _drill_wal_resume_mid_generation,

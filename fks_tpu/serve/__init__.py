@@ -1,7 +1,9 @@
 """Champion serving: pinned champion -> warm, no-recompile query engine.
 
-- artifact: champion loading, shape envelope, AOT ServeEngine, save/load.
-- batcher: query->workload construction, lane stacking, request coalescer.
+- artifact: champion loading, shape envelope, AOT ServeEngine (optionally
+  mesh-sharded with device-resident snapshot tables), save/load.
+- batcher: query->workload construction, lane stacking, packed-upload
+  helpers, request coalescer.
 - service: request/metrics layer, JSONL + localhost HTTP fronts, selftest.
 """
 from fks_tpu.serve.artifact import (
@@ -10,7 +12,9 @@ from fks_tpu.serve.artifact import (
 )
 from fks_tpu.serve.batcher import (
     DEFAULT_DURATION, POD_FIELDS, RequestBatcher, build_query_workload,
-    pods_to_dicts, stack_queries, validate_query_pods,
+    pack_query_tables, pods_to_dicts, query_pack_plan, stack_queries,
+    stack_query_tables, tree_h2d_bytes, unpack_query_tables,
+    validate_query_pods,
 )
 from fks_tpu.serve.service import ServeService, selftest
 
@@ -18,6 +22,8 @@ __all__ = [
     "ChampionSpec", "ServeEngine", "ShapeEnvelope",
     "enable_persistent_cache", "latest_champion", "load_champion",
     "DEFAULT_DURATION", "POD_FIELDS", "RequestBatcher",
-    "build_query_workload", "pods_to_dicts", "stack_queries",
-    "validate_query_pods", "ServeService", "selftest",
+    "build_query_workload", "pack_query_tables", "pods_to_dicts",
+    "query_pack_plan", "stack_queries", "stack_query_tables",
+    "tree_h2d_bytes", "unpack_query_tables", "validate_query_pods",
+    "ServeService", "selftest",
 ]

@@ -30,9 +30,12 @@ from __future__ import annotations
 
 import dataclasses
 import glob
+import hashlib
 import json
 import os
-from typing import Any, Dict, List, Optional, Sequence, Tuple
+import warnings
+from collections import OrderedDict
+from typing import Any, Dict, List, NamedTuple, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -40,9 +43,14 @@ import numpy as np
 
 from fks_tpu import obs
 from fks_tpu.data.entities import ClusterArrays, Workload
-from fks_tpu.parallel.mesh import pad_population
+from fks_tpu.parallel.mesh import (
+    make_sharded_serve_fn, num_shards, occupancy_stats, pad_population,
+    serve_lane_count, serve_sharding,
+)
 from fks_tpu.serve.batcher import (
-    build_query_workload, pods_to_dicts, stack_queries, validate_query_pods,
+    build_query_workload, pack_query_tables, pods_to_dicts, query_pack_plan,
+    stack_query_tables, tree_h2d_bytes, unpack_query_tables,
+    validate_query_pods,
 )
 from fks_tpu.sim import get_engine
 from fks_tpu.sim.engine import (
@@ -275,15 +283,43 @@ def _cluster_from_json(doc: dict) -> ClusterArrays:
 # ------------------------------------------------------------------ engine
 
 
+class _Inflight(NamedTuple):
+    """One dispatched-but-unharvested chunk of the double-buffered
+    answer pipeline."""
+
+    res: Any            # the executable's (async) SimResult
+    idxs: List[int]     # answer slots, in lane order
+    bucket: int
+    lanes: int
+    real: int
+
+
 class ServeEngine:
     """A pinned (champion, cluster, envelope) triple compiled for serving.
 
     One AOT ``Compiled`` executable per (lane_bucket, pod_bucket)
     combination, built on demand (or eagerly via ``warmup``) and cached
     for the engine's lifetime. The executable's signature is
-    ``(workload[L,...], ktable[L,K], state0[L,...]) -> SimResult[L,...]``
-    — the batch contents are arguments, the policy is a constant, so the
-    warm path runs zero Python tracing and zero XLA compilation.
+    ``(pods[L,...], ktable[L,K], state0[L,...]) -> SimResult[L,...]``
+    — the query deltas are arguments; the policy AND the pinned cluster
+    tables are closure constants (device-resident, never re-uploaded) —
+    so the warm path runs zero Python tracing and zero XLA compilation.
+
+    With a ``mesh`` the lane axis is sharded over the mesh's pop axes
+    (``parallel.mesh.make_sharded_serve_fn``): one executable per
+    (global_lanes, pod_bucket) spans every device, where global lanes =
+    per-device lane bucket x shard count; remainder lanes are
+    ``pad_population`` duplicates accounted by ``occupancy_stats``.
+
+    The hot path is built not to touch the host or the PCIe bus more
+    than it must: snapshot trigger tables are cached on device keyed on
+    a content hash of their bytes (``snapshot_cache_stats``), uploads
+    are 16-bit packed under ``state_pack`` (``query_pack_plan``), the
+    per-batch pods/state buffers are DONATED to the executable so steady
+    state allocates nothing net per batch, and ``answer_batch`` double-
+    buffers: chunk N+1's stacking + upload overlaps chunk N's execution,
+    synchronizing one chunk behind dispatch like the segmented replay
+    runner.
 
     ``engine`` picks the simulation module ("exact" serves reference
     semantics and is the parity default; "flat" trades the documented
@@ -297,6 +333,7 @@ class ServeEngine:
                  prefilter_k: Optional[int] = None,
                  state_pack: bool = False,
                  max_steps_factor: int = 8,
+                 mesh=None,
                  recorder=None, profiler=None):
         if engine == "fused":
             raise ValueError(
@@ -319,6 +356,18 @@ class ServeEngine:
         self._mod = get_engine(engine)
         self._compiled: Dict[Tuple[int, int], Any] = {}
         self.cold_compiles = 0
+        # mesh-wide serving: lane axis sharded over the pop axes
+        self.mesh = mesh
+        self._shards = num_shards(mesh) if mesh is not None else 1
+        self._sharding = serve_sharding(mesh) if mesh is not None else None
+        # device-resident snapshot tables: content-hash -> device buffer
+        self._ktable_cache: "OrderedDict[Tuple, Any]" = OrderedDict()
+        self._ktable_cache_cap = 32
+        self.snapshot_cache_hits = 0
+        self.snapshot_cache_misses = 0
+        # H2D accounting (bytes actually shipped per answered query)
+        self.h2d_bytes_total = 0
+        self.h2d_queries = 0
 
         n, g = self.cluster.n_padded, self.cluster.g_padded
         self.param_policy, self.params, self.policy_tier = \
@@ -379,50 +428,85 @@ class ServeEngine:
                                   self.envelope.min_real_pods(pod_bucket),
                                   cfg.snapshot_interval)
 
+    def _pack_plan(self, pod_bucket: int) -> dict:
+        """The bucket's static upload-packing plan (empty unless
+        ``state_pack``) — shared by compile, example and dispatch so the
+        packed avals can never diverge from the executable's."""
+        return query_pack_plan(self.bucket_config(pod_bucket), pod_bucket,
+                               self.envelope.max_gpu_milli)
+
     def _make_serve_fn(self, pod_bucket: int):
         """The jittable batched pipeline for one pod bucket: vmapped
         self-masking step driven by the shared ``run_batched_lanes``
-        scaffold, finalized per lane. The champion policy is a closure
-        constant; workload/ktable/state are traced ARGUMENTS."""
+        scaffold, finalized per lane. The champion policy AND the pinned
+        cluster tables are closure constants (device-resident — a batch
+        never re-uploads them); pods/ktable/state are traced ARGUMENTS,
+        widened on device from the packed wire format."""
         cfg = self.bucket_config(pod_bucket)
         max_steps = cfg.max_steps
         mod, pp, params = self._mod, self.param_policy, self.params
+        plan = self._pack_plan(pod_bucket)
+        cluster = dataclasses.replace(self.cluster, node_ids=())
 
-        def step_one(w, k, s):
+        def step_one(p, k, s):
+            w = Workload(cluster=cluster, pods=p, faults=None)
             return mod.build_step(
                 w, lambda pod, nodes: pp(params, pod, nodes),
                 cfg, k, max_steps)(s)
 
         vstep = jax.vmap(step_one, in_axes=(0, 0, 0))
-        vfin = jax.vmap(lambda w, s: mod.finalize(w, cfg, s),
-                        in_axes=(0, 0))
+        vfin = jax.vmap(
+            lambda p, s: mod.finalize(
+                Workload(cluster=cluster, pods=p, faults=None), cfg, s),
+            in_axes=(0, 0))
 
-        def serve_fn(wl, kt, state0):
-            final = run_batched_lanes(lambda s: vstep(wl, kt, s), state0,
+        def serve_fn(pods, kt, state0):
+            pods, kt = unpack_query_tables(pods, kt, plan)
+            final = run_batched_lanes(lambda s: vstep(pods, kt, s), state0,
                                       max_steps, active_fn=mod.lane_active)
-            return vfin(wl, final)
+            return vfin(pods, final)
 
         return serve_fn
 
+    @staticmethod
+    def _pad_kt(kt: np.ndarray, lanes: int) -> np.ndarray:
+        """Replicate the last query's snapshot table into pad lanes (the
+        ``pad_population`` rule, host-side so the table can be hashed and
+        uploaded as one contiguous buffer)."""
+        q = kt.shape[0]
+        if q < lanes:
+            kt = np.concatenate([kt, np.repeat(kt[-1:], lanes - q, axis=0)])
+        return kt
+
     def _example_batch(self, lanes: int, pod_bucket: int):
-        """A minimal valid batch at the bucket's exact avals, for
-        ``lower()``: the smallest query routing can send here, replicated
-        across lanes by the same ``pad_population`` path real batches
-        use."""
+        """A minimal valid batch at the bucket's exact avals (and, on a
+        mesh, exact shardings), for ``lower()``: the smallest query
+        routing can send here, replicated across lanes by the same
+        pack/pad path real batches use."""
         pods = [{"cpu_milli": 1, "memory_mib": 1, "creation_time": t,
                  "duration_time": 10}
                 for t in range(self.envelope.min_real_pods(pod_bucket))]
         cfg = self.bucket_config(pod_bucket)
-        stacked = stack_queries(self._mod, self.cluster, [pods], pod_bucket,
-                                cfg, self._klen(pod_bucket))
-        padded, _ = pad_population(stacked, lanes)
-        return padded
+        pq, kt, s0 = stack_query_tables(self._mod, self.cluster, [pods],
+                                        pod_bucket, cfg,
+                                        self._klen(pod_bucket))
+        pq, kt = pack_query_tables(pq, kt, self._pack_plan(pod_bucket))
+        (pq, s0), _ = pad_population((pq, s0), lanes)
+        example = (pq, jnp.asarray(self._pad_kt(kt, lanes)), s0)
+        if self._sharding is not None:
+            example = jax.device_put(example, self._sharding)
+        return example
 
     def compiled_for(self, lanes: int, pod_bucket: int):
-        """The (lanes, pod_bucket) AOT executable, compiling on first use.
-        ``jax.jit(...).lower(...).compile()`` returns a ``Compiled``
-        object whose __call__ never compiles — argument avals either
-        match or raise."""
+        """The (lanes, pod_bucket) AOT executable, compiling on first use
+        (``lanes`` is the GLOBAL lane count — per-device bucket x shard
+        count on a mesh). ``jax.jit(...).lower(...).compile()`` returns a
+        ``Compiled`` object whose __call__ never compiles — argument
+        avals either match or raise. pods (arg 0) and state0 (arg 2) are
+        donated: each batch's upload buffers are released to XLA, so
+        steady-state serving recycles instead of growing the arena; the
+        content-hash-cached ktable (arg 1) is NOT donated — its device
+        buffer must survive across batches."""
         key = (lanes, pod_bucket)
         hit = self._compiled.get(key)
         if hit is not None:
@@ -430,9 +514,18 @@ class ServeEngine:
         with self.profiler.stage("compile", lanes=lanes, pods=pod_bucket):
             with obs.span("serve_compile", lanes=lanes, pods=pod_bucket,
                           engine=self.engine_name):
+                fn = self._make_serve_fn(pod_bucket)
+                if self.mesh is not None:
+                    fn = make_sharded_serve_fn(fn, self.mesh)
                 example = self._example_batch(lanes, pod_bucket)
-                compiled = jax.jit(
-                    self._make_serve_fn(pod_bucket)).lower(*example).compile()
+                with warnings.catch_warnings():
+                    # buckets whose SimResult cannot alias a donated
+                    # input warn once per compile; donation still lets
+                    # XLA recycle the buffers as scratch
+                    warnings.filterwarnings("ignore",
+                                            message="Some donated")
+                    compiled = jax.jit(fn, donate_argnums=(0, 2)) \
+                        .lower(*example).compile()
         self._compiled[key] = compiled
         self.cold_compiles += 1
         return compiled
@@ -440,20 +533,69 @@ class ServeEngine:
     def warmup(self, lane_buckets: Optional[Sequence[int]] = None,
                pod_buckets: Optional[Sequence[int]] = None) -> int:
         """Eagerly compile every (lane, pod) bucket combination (or the
-        given subsets). Returns the number of executables now resident."""
+        given subsets; lane buckets are PER-DEVICE and scale by the mesh
+        shard count). Returns the number of executables now resident."""
         with self.profiler.stage("warmup"):
             for lb in lane_buckets or self.envelope.lane_buckets():
                 for pb in pod_buckets or self.envelope.pod_buckets():
-                    self.compiled_for(lb, pb)
+                    self.compiled_for(serve_lane_count(lb, self.mesh), pb)
         return len(self._compiled)
 
     # ----- answering
 
+    def _global_lanes(self, n_queries: int) -> int:
+        """Global lane count for an n-query chunk: the smallest envelope
+        lane bucket covering the PER-DEVICE share, scaled by the mesh."""
+        per_dev = -(-int(n_queries) // self._shards)
+        return serve_lane_count(self.envelope.lanes_for(max(1, per_dev)),
+                                self.mesh)
+
+    def snapshot_cache_stats(self) -> dict:
+        """Device-resident snapshot-table cache counters plus the H2D
+        accounting — the ``fks_serve_snapshot_cache_*`` gauge source."""
+        total = self.snapshot_cache_hits + self.snapshot_cache_misses
+        return {
+            "hits": self.snapshot_cache_hits,
+            "misses": self.snapshot_cache_misses,
+            "entries": len(self._ktable_cache),
+            "hit_rate": self.snapshot_cache_hits / total if total else 0.0,
+            "h2d_bytes_total": int(self.h2d_bytes_total),
+            "h2d_bytes_per_query": (self.h2d_bytes_total / self.h2d_queries
+                                    if self.h2d_queries else 0.0),
+        }
+
+    def _ktable_for(self, lanes: int, bucket: int, kt: np.ndarray):
+        """The device-resident snapshot-table buffer for this batch:
+        content-hash cache keyed on the (packed) table bytes at the
+        dispatch shape. Consecutive batches whose queries share pod
+        counts — the steady-serving common case — hash identically and
+        re-use the resident buffer, shipping zero snapshot bytes."""
+        digest = hashlib.blake2b(kt.tobytes(), digest_size=16).digest()
+        key = (lanes, bucket, kt.dtype.str, digest)
+        hit = self._ktable_cache.get(key)
+        if hit is not None:
+            self._ktable_cache.move_to_end(key)
+            self.snapshot_cache_hits += 1
+            return hit
+        self.snapshot_cache_misses += 1
+        padded = self._pad_kt(kt, lanes)
+        dev = (jax.device_put(padded, self._sharding)
+               if self._sharding is not None else jnp.asarray(padded))
+        self.h2d_bytes_total += int(padded.nbytes)
+        self._ktable_cache[key] = dev
+        while len(self._ktable_cache) > self._ktable_cache_cap:
+            self._ktable_cache.popitem(last=False)
+        return dev
+
     def answer_batch(self, pod_lists: Sequence[Sequence[dict]]) -> List[dict]:
         """Answer N "place this pod list" queries. Queries are grouped by
-        pod bucket, chunked at max_batch, lane-padded to the compiled
-        lane bucket (``pad_population`` — the request batcher), run
-        through the warm executable, and scattered back in input order."""
+        pod bucket, chunked at the mesh-wide max batch, lane-padded to
+        the compiled lane bucket (``pad_population`` — the request
+        batcher), run through the warm executable, and scattered back in
+        input order. Chunks are DOUBLE-BUFFERED: chunk i+1 is stacked,
+        uploaded and dispatched before chunk i's results are pulled, so
+        host staging and H2D overlap device compute (the segmented
+        replay runner's one-behind handoff, at the batch level)."""
         for pods in pod_lists:
             validate_query_pods(pods, max_pods=self.envelope.max_pods,
                                 max_gpu_milli=self.envelope.max_gpu_milli)
@@ -462,27 +604,51 @@ class ServeEngine:
         for i, pods in enumerate(pod_lists):
             groups.setdefault(
                 self.envelope.pod_bucket_for(len(pods)), []).append(i)
-        mb = self.envelope.max_batch
+        mb = self.envelope.max_batch * self._shards
+        inflight: Optional[_Inflight] = None
         for bucket, idxs in groups.items():
             for c0 in range(0, len(idxs), mb):
-                self._run_chunk(bucket, idxs[c0:c0 + mb], pod_lists, answers)
+                nxt = self._dispatch_chunk(bucket, idxs[c0:c0 + mb],
+                                           pod_lists)
+                if inflight is not None:
+                    self._harvest(inflight, pod_lists, answers)
+                inflight = nxt
+        if inflight is not None:
+            self._harvest(inflight, pod_lists, answers)
         return answers  # type: ignore[return-value]
 
-    def _run_chunk(self, bucket: int, idxs: List[int],
-                   pod_lists, answers) -> None:
-        lanes = self.envelope.lanes_for(len(idxs))
+    def _dispatch_chunk(self, bucket: int, idxs: List[int],
+                        pod_lists) -> "_Inflight":
+        """Stack + pack + upload one chunk and dispatch it (async): the
+        h2d profiler stage covers exactly the bytes shipped; execution
+        cost lands in ``_harvest``'s steady stage."""
+        lanes = self._global_lanes(len(idxs))
         cfg = self.bucket_config(bucket)
-        stacked = stack_queries(self._mod, self.cluster,
-                                [pod_lists[i] for i in idxs], bucket, cfg,
-                                self._klen(bucket))
-        (wl, kt, s0), real = pad_population(stacked, lanes)
+        pods, kt, s0 = stack_query_tables(
+            self._mod, self.cluster, [pod_lists[i] for i in idxs], bucket,
+            cfg, self._klen(bucket))
+        pods, kt = pack_query_tables(pods, kt, self._pack_plan(bucket))
         compiled = self.compiled_for(lanes, bucket)
-        from fks_tpu.parallel.mesh import occupancy_stats
+        (pods, s0), real = pad_population((pods, s0), lanes)
+        with self.profiler.stage("h2d", lanes=lanes, pods=bucket) as hh:
+            kt_dev = self._ktable_for(lanes, bucket, kt)
+            if self._sharding is not None:
+                pods, s0 = jax.device_put((pods, s0), self._sharding)
+            else:
+                pods, s0 = jax.device_put((pods, s0))
+            self.h2d_bytes_total += tree_h2d_bytes(pods, s0)
+            hh.sync(jax.tree_util.tree_leaves(s0)[0])
+        self.h2d_queries += len(idxs)
+        res = compiled(pods, kt_dev, s0)  # async dispatch; buffers donated
+        return _Inflight(res, list(idxs), bucket, lanes, real)
+
+    def _harvest(self, inflight: "_Inflight", pod_lists, answers) -> None:
+        """Block on a dispatched chunk and scatter its answers back."""
+        res, idxs, bucket, lanes, real = inflight
         with self.profiler.stage("steady", **occupancy_stats(real, lanes)) \
                 as hs:
             with obs.span("serve_batch", lanes=lanes, bucket_pods=bucket,
                           real=real) as t:
-                res = compiled(wl, kt, s0)
                 t.sync(res.policy_score)
             hs.sync(res.policy_score)
         res = jax.device_get(res)
@@ -565,11 +731,13 @@ class ServeEngine:
         return path
 
     @classmethod
-    def load(cls, directory: str, recorder=None) -> "ServeEngine":
+    def load(cls, directory: str, recorder=None, mesh=None) -> "ServeEngine":
         """Rebuild a saved engine. Self-contained: the artifact pins the
         cluster arrays and the resolved prefilter-k (no re-probe), and
         re-attaches the artifact's compilation cache so ``compiled_for``
-        fetches banked binaries instead of re-running XLA."""
+        fetches banked binaries instead of re-running XLA. ``mesh`` is a
+        RUNTIME property (device topology differs per process), so it is
+        passed here, never persisted."""
         with open(os.path.join(directory, "artifact.json")) as f:
             doc = json.load(f)
         if doc.get("version") != ARTIFACT_VERSION:
@@ -585,7 +753,7 @@ class ServeEngine:
                   prefilter_k=int(doc["prefilter_k"]),
                   state_pack=bool(doc["state_pack"]),
                   max_steps_factor=int(doc["max_steps_factor"]),
-                  recorder=recorder)
+                  mesh=mesh, recorder=recorder)
         enable_persistent_cache(os.path.join(directory, "xla_cache"))
         return eng
 

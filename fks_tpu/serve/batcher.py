@@ -105,26 +105,17 @@ def build_query_workload(cluster, pods: Sequence[Dict[str, Any]],
     return Workload(cluster=cluster, pods=pa, faults=None)
 
 
-def stack_queries(mod, cluster, pod_lists: Sequence[Sequence[dict]],
-                  bucket: int, cfg, klen: int):
-    """Stack Q query workloads into (workload[Q,...], ktable[Q,K],
-    state0[Q,...]) at the bucket's fixed shapes.
-
-    The ``stack_traces`` recipe with serving's extra constraint: K
-    (``klen``) is fixed per bucket so every batch matches the AOT
-    executable's avals. Each query's snapshot table is sized from its
-    REAL pod count (the reference's ``initialize(total_events)``
-    semantics) and padded with the INT32_MAX sentinel, which never fires.
-    ``cfg.max_steps`` must be the bucket's resolved step budget."""
-    max_steps = cfg.max_steps
-    assert max_steps is not None, "bucket SimConfig must pin max_steps"
-    wls = [build_query_workload(cluster, p, bucket) for p in pod_lists]
-    sentinel = np.iinfo(np.int32).max
-    kt = np.full((len(wls), klen), sentinel, np.int32)
+def _query_ktable(wls: Sequence[Workload], cfg, klen: int) -> np.ndarray:
+    """Per-query snapshot trigger tables at the bucket's fixed width:
+    each table is sized from the query's REAL pod count (the reference's
+    ``initialize(total_events)`` semantics) and padded with the INT32_MAX
+    sentinel, which never fires."""
+    kt = np.full((len(wls), klen), KT_SENTINEL, np.int32)
     for i, w in enumerate(wls):
         tbl = snapshot_trigger_table(
             w.num_pods,
-            max_snapshot_count(max_steps, w.num_pods, cfg.snapshot_interval),
+            max_snapshot_count(cfg.max_steps, w.num_pods,
+                               cfg.snapshot_interval),
             cfg.snapshot_interval)
         if len(tbl) > klen:
             raise ValueError(
@@ -132,6 +123,24 @@ def stack_queries(mod, cluster, pod_lists: Sequence[Sequence[dict]],
                 f"slots > bucket table width {klen}; route it to a smaller "
                 "bucket")
         kt[i, : len(tbl)] = tbl
+    return kt
+
+
+def stack_queries(mod, cluster, pod_lists: Sequence[Sequence[dict]],
+                  bucket: int, cfg, klen: int):
+    """Stack Q query workloads into (workload[Q,...], ktable[Q,K],
+    state0[Q,...]) at the bucket's fixed shapes.
+
+    The ``stack_traces`` recipe with serving's extra constraint: K
+    (``klen``) is fixed per bucket so every batch matches the AOT
+    executable's avals. ``cfg.max_steps`` must be the bucket's resolved
+    step budget. This is the historical full-workload stacking entry;
+    the mesh-sharded hot path uses ``stack_query_tables``, which splits
+    the constant cluster out of the per-batch upload."""
+    max_steps = cfg.max_steps
+    assert max_steps is not None, "bucket SimConfig must pin max_steps"
+    wls = [build_query_workload(cluster, p, bucket) for p in pod_lists]
+    kt = _query_ktable(wls, cfg, klen)
     states = [mod.initial_state(w, cfg) for w in wls]
     stacked_wl = jax.tree_util.tree_map(
         lambda *xs: jnp.stack([jnp.asarray(x) for x in xs]),
@@ -139,6 +148,114 @@ def stack_queries(mod, cluster, pod_lists: Sequence[Sequence[dict]],
     stacked_state = jax.tree_util.tree_map(
         lambda *xs: jnp.stack([jnp.asarray(x) for x in xs]), *states)
     return stacked_wl, jnp.asarray(kt), stacked_state
+
+
+def stack_query_tables(mod, cluster, pod_lists: Sequence[Sequence[dict]],
+                       bucket: int, cfg, klen: int):
+    """``stack_queries`` split for the device-resident serve hot path:
+    returns ``(pods[Q,...] numpy, ktable[Q,K] numpy, state0[Q,...])``.
+
+    The constant cluster arrays are NOT stacked or returned — the serve
+    engine bakes them into the compiled program as closure constants, so
+    a batch ships only the query delta (pod tables), the snapshot trigger
+    table (content-hash cached on device by the engine), and the initial
+    state. Pods and ktable stay host-side numpy so the engine can hash
+    the ktable bytes BEFORE any transfer and account every uploaded
+    byte; the upload itself is one explicit ``device_put`` at the
+    engine's h2d stage."""
+    max_steps = cfg.max_steps
+    assert max_steps is not None, "bucket SimConfig must pin max_steps"
+    wls = [build_query_workload(cluster, p, bucket) for p in pod_lists]
+    kt = _query_ktable(wls, cfg, klen)
+    stacked_pods = jax.tree_util.tree_map(
+        lambda *xs: np.stack([np.asarray(x) for x in xs]),
+        *[strip_ids(w).pods for w in wls])
+    states = [mod.initial_state(w, cfg) for w in wls]
+    stacked_state = jax.tree_util.tree_map(
+        lambda *xs: jnp.stack([jnp.asarray(x) for x in xs]), *states)
+    return stacked_pods, kt, stacked_state
+
+
+# ------------------------------------------------- packed query uploads
+#
+# SimConfig.state_pack narrows the FLAT engine's carry columns to 16-bit
+# where ranges provably fit (sim/flat.py). The serve upload path reuses
+# the same idea on the REQUEST tables: the wire/H2D format is 16-bit, the
+# engine widens back to int32 on device (a free VPU cast), and every
+# packing decision is static per bucket — never per batch — so packed
+# avals are stable and the warm path stays recompile-free.
+
+#: int32 sentinel in snapshot trigger tables ("never fires")
+KT_SENTINEL = np.iinfo(np.int32).max
+#: its image on the packed (uint16) upload path
+KT_SENTINEL_PACKED = np.iinfo(np.uint16).max
+
+
+def query_pack_plan(cfg, bucket: int, max_gpu_milli: int) -> dict:
+    """The static per-bucket packing plan for query upload tables (empty
+    unless ``cfg.state_pack``). Packable columns and their proofs:
+
+    - ``ktable`` -> uint16: trigger steps are bounded by the bucket's
+      ``max_steps`` plus the last fractional-progress rung (< max_steps
+      + bucket for the reference 0.05 interval), so they fit below the
+      remapped sentinel whenever ``max_steps + bucket + 4 < 65535``;
+    - ``gpu_milli`` -> int16: admission validates every pod against the
+      envelope's ``max_gpu_milli``;
+    - ``tie_rank`` -> int16: always ``arange(bucket)``.
+
+    All casts are integer->integer with proven ranges, so the round trip
+    through ``pack_query_tables``/``unpack_query_tables`` is
+    bit-identical (asserted by tests/test_serve_sharded.py)."""
+    if not getattr(cfg, "state_pack", False):
+        return {}
+    plan: Dict[str, Any] = {}
+    if (cfg.max_steps is not None
+            and cfg.max_steps + bucket + 4 < KT_SENTINEL_PACKED):
+        plan["ktable"] = np.uint16
+    if 0 <= int(max_gpu_milli) <= np.iinfo(np.int16).max:
+        plan["gpu_milli"] = np.int16
+    if bucket <= np.iinfo(np.int16).max:
+        plan["tie_rank"] = np.int16
+    return plan
+
+
+def pack_query_tables(pods: PodArrays, kt: np.ndarray, plan: dict):
+    """Apply a ``query_pack_plan`` to host-staged tables (numpy, before
+    upload). Identity when the plan is empty."""
+    if not plan:
+        return pods, kt
+    if "ktable" in plan:
+        kt = np.where(kt == KT_SENTINEL,
+                      KT_SENTINEL_PACKED, kt).astype(plan["ktable"])
+    repl = {f: np.asarray(getattr(pods, f)).astype(plan[f])
+            for f in ("gpu_milli", "tie_rank") if f in plan}
+    if repl:
+        pods = dataclasses.replace(pods, **repl)
+    return pods, kt
+
+
+def unpack_query_tables(pods, kt, plan: dict):
+    """Invert ``pack_query_tables`` ON DEVICE (traced inside the compiled
+    serve program): widen back to the engine's int32, remapping the
+    ktable sentinel. The H2D transfer stays packed."""
+    if not plan:
+        return pods, kt
+    if "ktable" in plan:
+        kt = jnp.where(kt == np.asarray(KT_SENTINEL_PACKED, plan["ktable"]),
+                       jnp.int32(KT_SENTINEL), kt.astype(jnp.int32))
+    repl = {f: getattr(pods, f).astype(jnp.int32)
+            for f in ("gpu_milli", "tie_rank") if f in plan}
+    if repl:
+        pods = dataclasses.replace(pods, **repl)
+    return pods, kt
+
+
+def tree_h2d_bytes(*trees) -> int:
+    """Total bytes a host->device upload of these pytrees ships — the
+    engine's ``serve_h2d_bytes_per_query`` accounting."""
+    return int(sum(x.nbytes for t in trees
+                   for x in jax.tree_util.tree_leaves(t)
+                   if hasattr(x, "nbytes")))
 
 
 def pods_to_dicts(pods: PodArrays, limit: Optional[int] = None) -> List[dict]:

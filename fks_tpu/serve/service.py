@@ -289,13 +289,22 @@ class ServeService:
             "engine_state": (self._degrade.state
                              if self._degrade is not None else "normal"),
         }
+        # device-resident snapshot cache + H2D accounting (engines
+        # predating the cache — or test doubles — simply omit the block)
+        cache_stats = getattr(self.engine, "snapshot_cache_stats", None)
+        if callable(cache_stats):
+            out["snapshot_cache"] = cache_stats()
         if self.slo.enabled:
             out["slo"] = record_slo_burn(
                 self.slo, self._latencies_ms, elapsed,
                 recorder=self.recorder if record else obs.NULL)
         if record:
             self.recorder.metric("serve", **{k: v for k, v in out.items()
-                                             if k != "slo"})
+                                             if k not in ("slo",
+                                                          "snapshot_cache")})
+            if callable(cache_stats):
+                self.recorder.metric("snapshot_cache",
+                                     **out["snapshot_cache"])
         return out
 
 
@@ -455,7 +464,7 @@ def selftest(engine: ServeEngine, count: int = 8, pods_per_query: int = 4,
         if drift > tol or not same:
             failures.append({"query": i, "drift": round(drift, 8),
                              "placements_match": same})
-    return {
+    out = {
         "ok": not failures,
         "checked": len(queries),
         "max_drift": round(max_drift, 10),
@@ -464,3 +473,9 @@ def selftest(engine: ServeEngine, count: int = 8, pods_per_query: int = 4,
         "engine": engine.engine_name,
         "failures": failures[:5],
     }
+    cache_stats = getattr(engine, "snapshot_cache_stats", None)
+    if callable(cache_stats):
+        out["snapshot_cache"] = cache_stats()
+    if getattr(engine, "mesh", None) is not None:
+        out["mesh_devices"] = int(getattr(engine, "_shards", 1))
+    return out

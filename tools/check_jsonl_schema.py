@@ -137,6 +137,11 @@ METRIC_KIND_REQUIRED: Dict[str, Tuple[str, ...]] = {
     # state-machine transition in promotion.jsonl, mirrored to the
     # flight recorder so a run dir tells the whole promotion story
     "promotion_event": ("attempt", "state", "champion"),
+    # device-resident snapshot cache (fks_tpu.serve.artifact): ktable
+    # reuse vs upload economics of the (sharded) serve path — the
+    # exporter renders these as fks_serve_snapshot_cache_* gauges
+    "snapshot_cache": ("hits", "misses", "entries", "hit_rate",
+                       "h2d_bytes_per_query"),
 }
 
 #: an OpenMetrics sample line: name, optional {labels}, value, optional ts

@@ -29,6 +29,14 @@ unbatched exact engine (score drift <= 1e-5, placements identical,
 exit 0). A drift here means the serving tier's lane stacking or
 scatter-back is corrupting answers. Recorded as ``serve_gate``.
 
+A SHARDED SERVE GATE follows: the same selftest on an 8-virtual-device
+dryrun mesh (``cli serve --cpu --devices 8 --state-pack --selftest``) —
+mesh-sharded, 16-bit-packed batched answers must still match the exact
+engine with 0.0 drift and identical placements. A drift here means the
+batch-axis pad/shard specs, the device-resident snapshot cache, or the
+pack/unpack pair is corrupting answers. Recorded as
+``sharded_serve_gate``.
+
 A LINT GATE follows: ``cli lint --cpu`` — the repo-wide JAX-invariant
 AST lints must be clean AND the pinned-jaxpr manifest
 (tests/fixtures/jaxpr_pins.json) must match the currently lowered
@@ -127,6 +135,26 @@ def serve_gate() -> dict:
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     proc = subprocess.run(
         [sys.executable, "-m", "fks_tpu.cli", "serve", "--cpu",
+         "--selftest", "4", "--pods-per-query", "3",
+         "--max-pods", "16", "--max-batch", "4"],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=600)
+    ok = proc.returncode == 0
+    detail = {"rc": proc.returncode}
+    if not ok:
+        detail["err"] = (proc.stderr or proc.stdout or "")[-500:]
+    return {"ok": ok, **detail}
+
+
+def sharded_serve_gate() -> dict:
+    """Sharded-serving parity: the same selftest on an 8-virtual-device
+    dryrun mesh with 16-bit packed uploads — batched mesh-sharded answers
+    must match the unbatched exact engine with 0.0 drift and identical
+    placements. Exercises the whole round-17 path: pad/shard specs,
+    device-resident snapshot cache, packed H2D. Returns {"ok": bool, ...}."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "-m", "fks_tpu.cli", "serve", "--cpu",
+         "--devices", "8", "--state-pack",
          "--selftest", "4", "--pods-per-query", "3",
          "--max-pods", "16", "--max-batch", "4"],
         capture_output=True, text=True, cwd=REPO, env=env, timeout=600)
@@ -246,6 +274,9 @@ def main() -> int:
     vgate = serve_gate()
     if not vgate["ok"]:
         print(f"SERVE GATE FAILED: {vgate}", file=sys.stderr)
+    hgate = sharded_serve_gate()
+    if not hgate["ok"]:
+        print(f"SHARDED SERVE GATE FAILED: {hgate}", file=sys.stderr)
     lgate = lint_gate()
     if not lgate["ok"]:
         print(f"LINT GATE FAILED: {lgate}", file=sys.stderr)
@@ -269,15 +300,15 @@ def main() -> int:
     counts = {k: int(v) for v, k in re.findall(
         r"(\d+) (passed|failed|error|skipped|deselected|xfailed)", summary)}
     gates_ok = (gate["ok"] and tgate["ok"] and sgate["ok"] and vgate["ok"]
-                and lgate["ok"] and ngate["ok"] and pgate["ok"]
-                and rgate["ok"])
+                and hgate["ok"] and lgate["ok"] and ngate["ok"]
+                and pgate["ok"] and rgate["ok"])
     rc = proc.returncode if gates_ok else (proc.returncode or 1)
     row = {"ts": round(time.time(), 1), "rev": rev, "rc": rc,
            "wall_s": wall, **counts, "obs_gate": gate,
            "trace_gate": tgate, "scale_gate": sgate, "serve_gate": vgate,
-           "lint_gate": lgate, "trends_gate": ngate,
-           "promote_gate": pgate, "resilience_gate": rgate,
-           "summary": summary}
+           "sharded_serve_gate": hgate, "lint_gate": lgate,
+           "trends_gate": ngate, "promote_gate": pgate,
+           "resilience_gate": rgate, "summary": summary}
     os.makedirs(os.path.dirname(OUT), exist_ok=True)
     with open(OUT, "a") as f:
         f.write(json.dumps(row) + "\n")
