@@ -1219,6 +1219,50 @@ def stage_serve(gate: str = "") -> int:
         f"qps {' '.join(f'b{b}={qps[b]:.1f}' for b in batches)}; "
         f"recompiles in warm passes: {recompiles}")
 
+    # tracing overhead: the same warm batch-1 requests through the
+    # ServeService request path, recorder off vs on — the per-request
+    # causal waterfall (fks_tpu.obs.trace_ctx) must be within noise
+    # (compare.py gates trace_overhead_pct at +2.0 points absolute).
+    # The traced run dir also yields the mean per-component split.
+    import tempfile
+
+    from fks_tpu.obs import FlightRecorder, trace_ctx
+    from fks_tpu.obs.report import read_jsonl
+    from fks_tpu.serve import ServeService
+
+    def _service_mean_ms(recorder) -> float:
+        svc = ServeService(engine, recorder=recorder, max_wait_s=0.0)
+        try:
+            t0 = time.perf_counter()
+            for i in range(reps):
+                svc.submit({"id": f"ovh-{i:03d}",
+                            "pods": queries[i % len(queries)]}).result()
+            return (time.perf_counter() - t0) * 1e3 / reps
+        finally:
+            svc.close()
+
+    trace_comp_ms = {}
+    with tempfile.TemporaryDirectory() as tmp:
+        from fks_tpu.obs import NULL
+        mean_off = _service_mean_ms(NULL)
+        traced = FlightRecorder(os.path.join(tmp, "traced"))
+        mean_on = _service_mean_ms(traced)
+        traced.finish("ok")
+        traced.close()
+        spans = trace_ctx.trace_spans(
+            read_jsonl(os.path.join(tmp, "traced", "events.jsonl")))
+        for comp in trace_ctx.SERVE_COMPONENTS:
+            secs = [float(s.get("seconds", 0.0)) for s in spans
+                    if str(s.get("path", "")).rpartition("/")[2] == comp]
+            trace_comp_ms[comp] = (sum(secs) / len(secs) * 1e3
+                                   if secs else 0.0)
+    trace_overhead_pct = ((mean_on - mean_off) / mean_off * 100.0
+                          if mean_off > 0 else 0.0)
+    log(f"trace overhead: {mean_off:.2f}ms off -> {mean_on:.2f}ms on "
+        f"({trace_overhead_pct:+.2f}%); components "
+        + " ".join(f"{c}={trace_comp_ms[c]:.3f}ms"
+                   for c in trace_ctx.SERVE_COMPONENTS))
+
     payload = {
         "serve_cold_seconds": round(cold_s, 3),
         "serve_p50_ms": round(p50, 3),
@@ -1240,6 +1284,10 @@ def stage_serve(gate: str = "") -> int:
     payload["snapshot_cache_hit_rate"] = round(cache["hit_rate"], 4)
     payload["serve_h2d_bytes_per_query"] = round(
         cache["h2d_bytes_per_query"], 1)
+    # causal-tracing cost + mean waterfall split (round 18; additive keys)
+    payload["trace_overhead_pct"] = round(trace_overhead_pct, 3)
+    payload.update({f"trace_{c}_ms": round(v, 4)
+                    for c, v in trace_comp_ms.items()})
     _record("metric", "bench_stage", payload, stage="serve",
             platform="cpu")
     _record("metric", "snapshot_cache", dict(cache))

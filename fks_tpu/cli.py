@@ -849,6 +849,112 @@ def cmd_watch(args):
         return 0
 
 
+def cmd_spans(args):
+    """Causal-trace viewer over a run directory's ``trace_span`` events
+    (fks_tpu.obs.trace_ctx): list traces, render one request's latency
+    waterfall (``--trace``), rank the slowest requests (``--slowest``),
+    verify every served request reconstructs a complete waterfall
+    (``--check-complete``, the run_full_suite trace gate), or print the
+    per-generation critical path with the device-idle vs LLM-idle split
+    (``--critical-path``, gated by ``--min-fraction``)."""
+    from fks_tpu.obs import trace_ctx
+    from fks_tpu.obs.report import load_run
+
+    try:
+        _meta, events, metrics = load_run(args.run_dir)
+    except FileNotFoundError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    spans = trace_ctx.trace_spans(events)
+    by = trace_ctx.traces_by_id(spans)
+
+    if args.trace:
+        match = by.get(args.trace)
+        if match is None:  # allow unambiguous prefixes (ids are long)
+            hits = [t for t in by if t.startswith(args.trace)]
+            if len(hits) != 1:
+                print(f"error: trace {args.trace!r} "
+                      f"{'is ambiguous' if hits else 'not found'} "
+                      f"({len(by)} traces in run)", file=sys.stderr)
+                return 2
+            match = by[hits[0]]
+        print(trace_ctx.render_waterfall(match))
+        return 0
+
+    def _root(tid):
+        roots = [s for s in by[tid] if not s.get("parent_id")]
+        return roots[0] if len(roots) == 1 else None
+
+    if args.check_complete:
+        # every request the service REPORTED serving must reconstruct a
+        # complete causally-linked waterfall — the metric stream is the
+        # ground truth for what was served, the event stream must match
+        served = [m for m in metrics if m.get("kind") == "serve_request"
+                  and m.get("trace_id")]
+        bad = [m["trace_id"] for m in served
+               if not trace_ctx.waterfall_complete(by.get(m["trace_id"], []))]
+        print(f"served requests: {len(served)}  "
+              f"complete waterfalls: {len(served) - len(bad)}")
+        for tid in bad[:10]:
+            print(f"  INCOMPLETE {tid}")
+        if not served:
+            print("error: no traced serve_request metrics in run",
+                  file=sys.stderr)
+            return 1
+        return 1 if bad else 0
+
+    if args.critical_path:
+        gens = sorted(t for t in by if _root(t) is not None
+                      and _root(t).get("path") == "generation")
+        if not gens:
+            print("error: no generation traces in run", file=sys.stderr)
+            return 1
+        failed = 0
+        print(f"{'trace':<22} {'wall s':>8} {'attr %':>7} "
+              f"{'dev-idle s':>10} {'llm-idle s':>10}  bounding")
+        for tid in gens:
+            cp = trace_ctx.critical_path(by[tid])
+            if not cp.get("ok"):
+                failed += 1
+                print(f"{tid:<22} (no root span)")
+                continue
+            frac = cp["attributed_fraction"]
+            if frac < args.min_fraction:
+                failed += 1
+            print(f"{tid:<22} {cp['wall_seconds']:>8.3f} "
+                  f"{frac * 100:>6.1f}% {cp['device_idle_seconds']:>10.3f} "
+                  f"{cp['llm_idle_seconds']:>10.3f}  "
+                  f"{cp['bounding_stage']}"
+                  f"{'  << below min-fraction' if frac < args.min_fraction else ''}")
+        return 1 if failed else 0
+
+    order = sorted(
+        by, key=lambda t: -max(float(s.get("seconds", 0.0))
+                               for s in by[t]))
+    if args.slowest:
+        shown = [t for t in order
+                 if _root(t) is not None
+                 and _root(t).get("path") == trace_ctx.SERVE_ROOT]
+        for tid in shown[: args.slowest]:
+            print(trace_ctx.render_waterfall(by[tid]))
+            print()
+        if not shown:
+            print("error: no serve/request traces in run", file=sys.stderr)
+            return 1
+        return 0
+
+    print(f"{len(by)} traces, {len(spans)} spans")
+    for tid in order[:30]:
+        root = _root(tid)
+        path = root.get("path", "?") if root else "(torn)"
+        wall = max(float(s.get("seconds", 0.0)) for s in by[tid])
+        print(f"  {tid:<24} {path:<16} {wall * 1e3:>10.3f} ms  "
+              f"{len(by[tid])} spans")
+    if len(by) > 30:
+        print(f"  ... {len(by) - 30} more (use --trace/--slowest)")
+    return 0
+
+
 def cmd_compare(args):
     """Cross-run regression gate: diff two run dirs (or bench JSONL files)
     on the shared metric vocabulary — throughput, compile seconds, fitness
@@ -1420,6 +1526,27 @@ def main(argv=None) -> int:
     w.add_argument("--once", action="store_true",
                    help="print one snapshot + verdict and exit")
     w.set_defaults(fn=cmd_watch)
+
+    sp = sub.add_parser("spans",
+                        help="causal-trace viewer: per-request latency "
+                             "waterfalls and evolve critical paths")
+    sp.add_argument("run_dir", help="directory written by --run-dir")
+    sp.add_argument("--trace", metavar="ID",
+                    help="render the waterfall of one trace "
+                         "(unambiguous id prefix accepted)")
+    sp.add_argument("--slowest", type=int, metavar="N",
+                    help="render the N slowest serve/request waterfalls")
+    sp.add_argument("--check-complete", action="store_true",
+                    help="exit 1 unless every traced serve_request "
+                         "reconstructs a complete waterfall")
+    sp.add_argument("--critical-path", action="store_true",
+                    help="per-generation critical path with device-idle "
+                         "vs LLM-idle seconds")
+    sp.add_argument("--min-fraction", type=float, default=0.95,
+                    help="with --critical-path: fail if any generation "
+                         "attributes less than this fraction of its "
+                         "wall (default 0.95)")
+    sp.set_defaults(fn=cmd_spans)
 
     c = sub.add_parser("compare",
                        help="regression-gate a candidate run against a "

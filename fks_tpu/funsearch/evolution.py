@@ -22,6 +22,7 @@ import contextlib
 import dataclasses
 import difflib
 import functools
+import hashlib
 import json
 import os
 import random
@@ -31,6 +32,7 @@ from typing import Callable, List, Optional, Sequence, Tuple
 import jax
 
 from fks_tpu import obs
+from fks_tpu.obs import trace_ctx
 from fks_tpu.funsearch import llm as llm_mod
 from fks_tpu.funsearch import template
 from fks_tpu.funsearch.backend import CodeEvaluator, EvalRecord
@@ -204,6 +206,13 @@ def _percentile(sorted_desc: Sequence[float], q: float) -> float:
     idx = min(len(sorted_desc) - 1,
               max(0, int(round((1.0 - q) * (len(sorted_desc) - 1)))))
     return float(sorted_desc[idx])
+
+
+def _code_sha(code: str) -> str:
+    """Content address of a candidate's source — the key that links an
+    evolve-generation candidate span to the promotion attempt serving
+    it (fks_tpu.pipeline.controller stamps the same hash)."""
+    return hashlib.sha1(code.encode()).hexdigest()[:12]
 
 
 def _failure_counts(records) -> Tuple[int, int]:
@@ -492,6 +501,24 @@ class FunSearch:
 
     def evolve_generation(self) -> GenerationStats:
         self.generation += 1
+        # one causal trace per generation (fks_tpu.obs.trace_ctx): the
+        # llm/evaluate/rank/commit spans become children of a root
+        # ``generation`` span, so ``cli spans --critical-path`` can read
+        # the device-idle (LLM-bound) vs LLM-idle split straight off the
+        # trail; per-candidate marker spans carry a content hash linking
+        # this generation to any promotion attempt its champion wins
+        gen_ctx = (trace_ctx.new_trace(prefix="gen")
+                   if getattr(self.recorder, "enabled", False) else None)
+        t_gen0 = time.perf_counter()
+        with trace_ctx.activate(gen_ctx):
+            stats = self._evolve_generation_body()
+            trace_ctx.emit(self.recorder, "generation",
+                           time.perf_counter() - t_gen0, ctx=gen_ctx,
+                           root=True, generation=self.generation,
+                           candidates=stats.new_candidates)
+        return stats
+
+    def _evolve_generation_body(self) -> GenerationStats:
         cfg = self.cfg
         with self.profiler.stage("codegen", generation=self.generation):
             self.ledger.begin_generation()
@@ -539,10 +566,22 @@ class FunSearch:
         with obs.span("evaluate", generation=self.generation,
                       candidates=len(codes)) as t:
             records = self._evaluate_with_wal(codes, cached_codes)
+            if getattr(self.recorder, "enabled", False):
+                # content-addressed candidate markers: code_sha is the
+                # key the promotion controller stamps on its attempts,
+                # so ledger -> shadow -> swap links back to the evolve
+                # generation that produced the champion
+                for r in records:
+                    trace_ctx.emit(
+                        self.recorder, "evaluate/candidate", 0.0,
+                        code_sha=_code_sha(r.code),
+                        score=round(float(r.score), 6),
+                        generation=self.generation)
         eval_s = t.seconds
         sandbox_failed, transpile_failed = _failure_counts(records)
 
-        with self.profiler.stage("rank", generation=self.generation) as hr:
+        with self.profiler.stage("rank", generation=self.generation) as hr, \
+                obs.span("rank", generation=self.generation):
             # eval-budget ledger: one budget_rung metric per rung (entered
             # / survived / device-seconds / segment count), then the
             # champion audit — pruning may never change who wins a
@@ -599,7 +638,8 @@ class FunSearch:
             parity = self.sentinel.check(self.generation, self.population)
             hr.annotate(accepted=accepted, rejected_similar=rejected)
 
-        with self.profiler.stage("ledger", generation=self.generation):
+        with self.profiler.stage("ledger", generation=self.generation), \
+                obs.span("commit", generation=self.generation):
             stats = self._commit_generation(
                 codes, eval_s, llm_s, sandbox_failed, transpile_failed,
                 fallbacks0, wd_flags, parity, budget_alerts, budget_rungs,

@@ -12,6 +12,9 @@ jitted code.
                   recorder (``get_recorder``/``recording``)
 - ``spans``     — nested wall-clock scopes mirrored into xprof
                   (generalizes ``utils.profiling.timed``)
+- ``trace_ctx`` — causal trace contexts (trace_id/span_id/parent_id)
+                  propagated explicitly across thread boundaries, plus
+                  waterfall/critical-path reconstruction (``cli spans``)
 - ``telemetry`` — jax.monitoring compile listener, device memory_stats,
                   mesh/pad-waste snapshots
 - ``ledger``    — per-generation evolution records
@@ -49,6 +52,11 @@ from fks_tpu.obs.recorder import (
 )
 from fks_tpu.obs.report import render_report, sparkline
 from fks_tpu.obs.spans import span, span_path
+from fks_tpu.obs import trace_ctx
+from fks_tpu.obs.trace_ctx import (
+    TraceContext, activate_trace, critical_path, current_trace, emit_span,
+    new_trace, render_waterfall,
+)
 from fks_tpu.obs.tracing import (
     align_traces, candidate_trace_diff, extract_trace, format_diff,
     trace_diff,
@@ -74,5 +82,7 @@ __all__ = [
     "profile_launch", "record_devices", "record_mesh", "record_slo_burn",
     "recording", "render_report", "resolve_auto_baseline", "run_health",
     "slo_burn", "span", "span_path", "sparkline", "to_openmetrics",
-    "trace_diff", "watch", "watch_compiles",
+    "trace_diff", "watch", "watch_compiles", "TraceContext",
+    "activate_trace", "critical_path", "current_trace", "emit_span",
+    "new_trace", "render_waterfall", "trace_ctx",
 ]

@@ -73,6 +73,11 @@ DEFAULT_THRESHOLDS: Dict[str, Threshold] = {
     "serve_sharded_qps": Threshold(higher_is_better=True, rel=0.10),
     "serve_h2d_bytes_per_query": Threshold(higher_is_better=False,
                                            rel=0.0, abs_tol=64.0),
+    # causal tracing (bench stage_serve): per-request trace emission must
+    # stay within noise of the untraced service path — more than a
+    # 2-point absolute jump in overhead means the null/hot path grew a
+    # real cost (the value is already a percentage, so abs only)
+    "trace_overhead_pct": Threshold(higher_is_better=False, abs_tol=2.0),
     # static pre-flight (bench stage_preflight): the fraction of the
     # candidate stream rejected before sandbox/transpile must not drop
     # more than 5 points — a drop means the analyzer stopped catching a
@@ -116,9 +121,10 @@ def _from_run_dir(run_dir: str) -> Dict[str, float]:
             v = _num(m.get(key))
             if v is not None:
                 out[key] = max(out.get(key, 0.0), v)
-        # latency/upload volume: best (lowest) observation, mirroring
-        # serve_qps's max
-        for key in ("serve_p99_ms", "serve_h2d_bytes_per_query"):
+        # latency/upload volume/trace cost: best (lowest) observation,
+        # mirroring serve_qps's max
+        for key in ("serve_p99_ms", "serve_h2d_bytes_per_query",
+                    "trace_overhead_pct"):
             v = _num(m.get(key))
             if v is not None:
                 out[key] = min(out.get(key, v), v)
@@ -158,12 +164,13 @@ def _from_jsonl(path: str, allow_stale: bool = False) -> Dict[str, float]:
                     "parity_max_drift", "budget_speedup",
                     "budget_champion_match", "scale1k_events_per_sec",
                     "serve_p99_ms", "serve_qps", "serve_sharded_qps",
-                    "serve_h2d_bytes_per_query", "preflight_reject_rate"):
+                    "serve_h2d_bytes_per_query", "preflight_reject_rate",
+                    "trace_overhead_pct"):
             v = _num(rec.get(key))
             if v is None:
                 continue
             if key in ("compile_seconds", "serve_p99_ms",
-                       "serve_h2d_bytes_per_query"):
+                       "serve_h2d_bytes_per_query", "trace_overhead_pct"):
                 out[key] = min(out.get(key, v), v)
             else:
                 out[key] = max(out.get(key, v), v)

@@ -35,6 +35,10 @@ MIN_CADENCE_SECONDS = 5.0
 
 PREFIX = "fks"
 
+#: fks_serve_latency_seconds histogram bucket bounds (seconds)
+LATENCY_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                   0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
+
 #: (metric suffix, source key, help) for per-generation gauges
 GENERATION_GAUGES = (
     ("generation_best_score", "best_score", "best fitness in population"),
@@ -252,6 +256,14 @@ def to_openmetrics(run_dir: str) -> str:
             "(post-packing, cache-discounted)").add(
             c.get("h2d_bytes_per_query"), run_id=run_id)
 
+    # per-request latency histogram with trace-id EXEMPLARS: each bucket
+    # cites the slowest request that landed in it, so a fat-tail bucket
+    # on a dashboard links straight to the ``cli spans --trace`` waterfall
+    # explaining it
+    hist = _latency_histogram(metrics, run_id)
+    if hist is not None:
+        fams[hist.name] = hist
+
     counts: Dict[str, int] = {}
     for e in events:
         kind = e.get("kind", "?")
@@ -287,13 +299,67 @@ def to_openmetrics(run_dir: str) -> str:
     return "\n".join(lines) + "\n"
 
 
+def _latency_histogram(metrics: List[Dict[str, Any]],
+                       run_id: str) -> Optional[_Family]:
+    """``fks_serve_latency_seconds``: cumulative histogram over the run's
+    ``serve_request`` latencies, with an OpenMetrics EXEMPLAR on every
+    non-empty bucket — the slowest traced request that landed there
+    (``# {trace_id="..."} value`` suffix), so hot buckets link to their
+    causal waterfall."""
+    lats: List[Tuple[float, Optional[str]]] = []
+    for m in metrics:
+        if m.get("kind") != "serve_request":
+            continue
+        v = _num(m.get("latency_ms"))
+        if v is not None:
+            lats.append((v / 1e3, m.get("trace_id")))
+    if not lats:
+        return None
+    f = _Family(f"{PREFIX}_serve_latency_seconds", "histogram",
+                "per-request serve latency (exemplars cite the slowest "
+                "traced request per bucket)")
+    lab = _labels(run_id=run_id)[1:-1]  # inner body, le= appended per bucket
+    cum = 0
+    lo = -1.0  # first bucket includes zero-latency samples
+    for le in (*LATENCY_BUCKETS, float("inf")):
+        inside = [(s, t) for s, t in lats if lo < s <= le] if le != float(
+            "inf") else [(s, t) for s, t in lats if s > lo]
+        cum += len(inside)
+        le_s = "+Inf" if le == float("inf") else f"{le:.10g}"
+        line = f'{f.name}_bucket{{{lab},le="{le_s}"}} {cum}'
+        exemplar = max((p for p in inside if p[1]), default=None)
+        if exemplar is not None:
+            line += (f' # {{trace_id="{_escape_label(exemplar[1])}"}}'
+                     f" {exemplar[0]:.6g}")
+        f.samples.append(line)
+        lo = le
+    f.samples.append(
+        f"{f.name}_sum{{{lab}}} {sum(s for s, _ in lats):.6g}")
+    f.samples.append(f"{f.name}_count{{{lab}}} {len(lats)}")
+    return f
+
+
 def _heartbeat_age(run_dir: str) -> Optional[float]:
-    """Seconds since the run's last heartbeat, None when absent/corrupt."""
+    """Seconds since the run's last heartbeat, None when absent/corrupt.
+
+    Two clocks bound the age: the timestamp INSIDE the file (the
+    writer's wall clock) and the file's mtime (the filesystem's clock).
+    On a shared filesystem either can lag or lead — writer/reader clock
+    skew, NFS attribute-cache delay — and a one-sided read flaps a
+    healthy run between STALE and DEAD. The age is the SMALLER of the
+    two (most recent evidence of life), clamped at zero against skew
+    that puts the heartbeat in the future."""
     path = os.path.join(run_dir, "heartbeat")
     try:
         with open(path) as f:
             beat = json.load(f)
-        return max(0.0, time.time() - float(beat["ts"]))
+        now = time.time()
+        age = now - float(beat["ts"])
+        try:
+            age = min(age, now - os.path.getmtime(path))
+        except OSError:
+            pass
+        return max(0.0, age)
     except (OSError, ValueError, KeyError, TypeError):
         return None
 

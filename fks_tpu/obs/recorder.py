@@ -36,6 +36,7 @@ import threading
 import time
 from typing import Any, Dict, Iterator, Optional
 
+from fks_tpu.obs import trace_ctx
 from fks_tpu.utils.logging import MetricsWriter, json_ready
 
 
@@ -114,7 +115,16 @@ class FlightRecorder(NullRecorder):
         device/mesh snapshots). ``seq`` is a process-wide monotonic
         counter so concurrent writers (compile listeners fire from the
         evaluator's thread pool) keep a total order even when ``ts``
-        collides at clock resolution."""
+        collides at clock resolution.
+
+        An active trace context (fks_tpu.obs.trace_ctx) stamps its
+        trace_id onto every event written under it — shed / degraded /
+        drain / promotion events correlate to the request or attempt
+        that caused them without each call site threading the id."""
+        if "trace_id" not in fields:
+            ctx = trace_ctx.current()
+            if ctx is not None:
+                fields["trace_id"] = ctx.trace_id
         with self._seq_lock:
             seq = self._seq
             self._seq += 1

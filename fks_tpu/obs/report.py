@@ -126,16 +126,25 @@ def _compile_section(events: List[Dict[str, Any]]) -> List[str]:
 
 
 def _span_section(events: List[Dict[str, Any]]) -> List[str]:
-    spans = [e for e in events if e.get("kind") == "span"]
+    # trace_span rows are spans that additionally carry causal ids
+    # (fks_tpu.obs.trace_ctx) — aggregate both kinds under one table
+    spans = [e for e in events if e.get("kind") in ("span", "trace_span")]
     if not spans:
         return []
     agg: Dict[str, Dict[str, float]] = {}
+    traces = set()
     for s in spans:
         a = agg.setdefault(s.get("path", s.get("label", "?")),
                            {"count": 0, "seconds": 0.0})
         a["count"] += 1
         a["seconds"] += float(s.get("seconds", 0.0))
-    lines = ["spans (by path, total wall):"]
+        if s.get("trace_id"):
+            traces.add(s["trace_id"])
+    head = "spans (by path, total wall):"
+    if traces:
+        head = (f"spans (by path, total wall; {len(traces)} traces — "
+                "'fks_tpu spans DIR' for waterfalls):")
+    lines = [head]
     for path, a in sorted(agg.items(), key=lambda kv: -kv[1]["seconds"]):
         lines.append(f"  {path}: {int(a['count'])}x {a['seconds']:.3f}s")
     return lines

@@ -15,9 +15,18 @@ contract) and adds three things:
   value materializes) the active recorder gets one ``kind="span"`` event
   with label/path/depth/seconds plus caller fields.
 
+- **causal linkage**: when a ``trace_ctx`` context is active on the
+  thread (a serve request, an evolve generation, a promotion attempt),
+  the event is emitted as ``kind="trace_span"`` carrying trace_id /
+  span_id / parent_id, and a child context is active for the span body —
+  so nested spans (and anything they hand to another thread) chain to
+  this one. No active context: the pre-trace ``kind="span"`` event,
+  bit-for-bit.
+
 With the NullRecorder active and no profiler attached, a span costs two
-perf_counter reads, two cheap context entries, and one no-op method call —
-nothing touches the filesystem and nothing is added to jitted code.
+perf_counter reads, two cheap context entries, one thread-local read and
+one no-op method call — nothing touches the filesystem and nothing is
+added to jitted code.
 """
 from __future__ import annotations
 
@@ -28,6 +37,7 @@ from typing import Any, Iterator, Optional
 import jax
 
 from fks_tpu.utils import profiling
+from fks_tpu.obs import trace_ctx
 from fks_tpu.obs.recorder import get_recorder
 
 _nesting = threading.local()
@@ -53,10 +63,20 @@ def span(label: str, sync: Any = None, recorder=None,
     depth = len(stack)
     stack.append(label)
     timing: Optional[profiling.Timing] = None
+    # causal chain: an active trace context turns this span into a
+    # trace_span child and re-parents anything opened inside the body
+    parent = trace_ctx.current() if rec.enabled else None
+    child = trace_ctx.child_of(parent) if parent is not None else None
 
     def _emit(t: profiling.Timing) -> None:
-        rec.event("span", label=label, path=path, depth=depth,
-                  seconds=round(t.seconds, 6), **fields)
+        if child is not None:
+            rec.event("trace_span", label=label, path=path, depth=depth,
+                      seconds=round(t.seconds, 6),
+                      trace_id=child.trace_id, span_id=child.span_id,
+                      parent_id=parent.span_id, **fields)
+        else:
+            rec.event("span", label=label, path=path, depth=depth,
+                      seconds=round(t.seconds, 6), **fields)
 
     try:
         with contextlib.ExitStack() as ctx:
@@ -67,6 +87,8 @@ def span(label: str, sync: Any = None, recorder=None,
                 ctx.enter_context(jax.named_scope(label))
             except Exception:  # pragma: no cover - profiler-less backend
                 pass
+            if child is not None:
+                ctx.enter_context(trace_ctx.activate(child))
             with profiling.timed(label, sync=sync, on_exit=_emit) as timing:
                 yield timing
     finally:

@@ -43,6 +43,7 @@ from typing import Any, Callable, Dict, List, Optional
 import numpy as np
 
 from fks_tpu import obs
+from fks_tpu.obs import trace_ctx
 from fks_tpu.obs.history import SLOConfig, slo_burn
 from fks_tpu.pipeline.faults import FaultPlan, KillSwitch, NO_FAULTS
 from fks_tpu.pipeline.state import PromotionLog, TERMINAL
@@ -144,11 +145,33 @@ class PromotionController:
     # --------------------------------------------------------- attempt
 
     def _attempt(self, aid: str, path: str) -> Dict[str, Any]:
+        """One promotion attempt under ONE causal trace: the trace id is
+        derived from the content-addressed attempt id (``promo-<aid>``),
+        so a restarted controller resuming the same attempt continues
+        the SAME trace, and every ledger transition / shadow stage /
+        swap event it writes correlates without threading ids."""
+        ctx = (trace_ctx.TraceContext(f"promo-{aid}", trace_ctx.new_span_id())
+               if getattr(self.recorder, "enabled", False) else None)
+        t0 = time.perf_counter()
+        with trace_ctx.activate(ctx):
+            out = self._attempt_decide(aid, path)
+            trace_ctx.emit(self.recorder, "promotion",
+                           time.perf_counter() - t0, ctx=ctx, root=True,
+                           attempt=aid, action=out.get("action", "?"))
+        return out
+
+    def _attempt_decide(self, aid: str, path: str) -> Dict[str, Any]:
         self._transition(aid, "PENDING", champion=path)
         try:
             champ = load_champion(path)
         except (ValueError, OSError) as e:
             return self._reject(aid, path, f"load_failed: {e}")
+        # content link to the evolve generation that produced this
+        # champion: the same sha1(code) the candidate marker spans carry
+        trace_ctx.emit(self.recorder, "promotion/candidate", 0.0,
+                       code_sha=hashlib.sha1(
+                           champ.code.encode()).hexdigest()[:12],
+                       attempt=aid, score=round(champ.score, 6))
         incumbent = self.service.engine
         gain = champ.score - incumbent.champion.score
         if gain < self.cfg.min_score_gain or gain <= 0:
@@ -160,7 +183,8 @@ class PromotionController:
         t0 = time.perf_counter()
         try:
             self.faults.maybe_eval_error()
-            shadow = self._factory(champ)
+            with obs.span("build", attempt=aid):
+                shadow = self._factory(champ)
         except KillSwitch:
             raise
         except Exception as e:  # device eval / transpile / OOM — degrade
@@ -168,7 +192,8 @@ class PromotionController:
                                 f"build_failed: {type(e).__name__}: {e}")
         self._transition(aid, "SHADOW", champion=path)
         try:
-            verdict = self._shadow_eval(shadow, incumbent)
+            with obs.span("shadow", attempt=aid):
+                verdict = self._shadow_eval(shadow, incumbent)
         except KillSwitch:
             raise
         except Exception as e:
@@ -188,6 +213,8 @@ class PromotionController:
         t1 = time.perf_counter()
         old = self.service.swap_engine(shadow)
         self.last_swap_ms = round((time.perf_counter() - t1) * 1e3, 3)
+        trace_ctx.emit(self.recorder, "promotion/swap",
+                       self.last_swap_ms / 1e3, attempt=aid)
         self._done.add(aid)
         self._probation = {"attempt": aid, "champion": path,
                            "old_engine": old,
@@ -354,10 +381,14 @@ class PromotionController:
 
     def _transition(self, aid: str, state: str, **detail) -> None:
         """Durable log append + promotion_event metric, THEN the kill
-        hook — a drill kill always lands after the record is on disk."""
+        hook — a drill kill always lands after the record is on disk.
+        An active promotion trace stamps its id onto the metric (the
+        durable log keeps its schema untouched)."""
         self.log.append(aid, state, **detail)
+        ctx = trace_ctx.current()
         self.recorder.metric("promotion_event", attempt=aid, state=state,
-                             **detail)
+                             **detail,
+                             **({"trace_id": ctx.trace_id} if ctx else {}))
         self.faults.maybe_kill(state)
 
 

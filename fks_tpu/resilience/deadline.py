@@ -37,15 +37,22 @@ class ResilienceError(RuntimeError):
 
     def __init__(self, message: str, *,
                  retry_after_s: Optional[float] = None,
-                 reason: Optional[str] = None):
+                 reason: Optional[str] = None,
+                 trace_id: Optional[str] = None):
         super().__init__(message)
         self.retry_after_s = retry_after_s
         self.reason = reason if reason is not None else self.kind
+        # causal correlation (fks_tpu.obs.trace_ctx): set by the layer
+        # that knows the request's trace, so a 503 body names the trace
+        # whose flight-recorder spans explain it
+        self.trace_id = trace_id
 
     def to_json(self) -> Dict[str, Any]:
         doc: Dict[str, Any] = {"error": str(self), "kind": self.kind}
         if self.retry_after_s is not None:
             doc["retry_after_s"] = round(float(self.retry_after_s), 4)
+        if self.trace_id:
+            doc["trace_id"] = self.trace_id
         return doc
 
 

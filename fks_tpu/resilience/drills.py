@@ -142,6 +142,31 @@ def _degrade_traffic_parity(stack, service, n: int) -> float:
     return _traffic_drift(stack, stack.traffic(service, n))
 
 
+def _connected_traces(run_dir: str) -> Dict[str, Any]:
+    """Read a degrade drill's flight-recorder dir back and verify the
+    causal-trace contract (fks_tpu.obs.trace_ctx): every served request
+    reconstructs a COMPLETE waterfall, and the faulted batch's requests
+    carry a ``primary_attempt`` child — primary-fail -> fallback-retry
+    linked on ONE trace."""
+    from fks_tpu.obs import trace_ctx
+    from fks_tpu.obs.report import read_jsonl
+
+    events = read_jsonl(os.path.join(run_dir, "events.jsonl"))
+    metrics = read_jsonl(os.path.join(run_dir, "metrics.jsonl"))
+    by = trace_ctx.traces_by_id(trace_ctx.trace_spans(events))
+    served = [m["trace_id"] for m in metrics
+              if m.get("kind") == "serve_request" and m.get("trace_id")]
+    complete = [t for t in served
+                if trace_ctx.waterfall_complete(by.get(t, []))]
+    retried = [t for t in served
+               if any(s.get("path") == "serve/request/primary_attempt"
+                      for s in by.get(t, []))]
+    return {"served": len(served), "complete": len(complete),
+            "retried": len(retried),
+            "traces_ok": bool(served) and len(complete) == len(served)
+            and bool(retried)}
+
+
 def _drill_device_loss_mid_batch(stack) -> Dict[str, Any]:
     """An injected device fault mid-batch flips the service to the
     reduced-batch exact fallback and the SAME batch is retried there —
@@ -173,40 +198,48 @@ def _drill_degrade_then_recover(stack) -> Dict[str, Any]:
     """Fault -> fallback -> rebuilt primary -> probation -> normal. The
     rebuild hands back the WARM incumbent, so post-recovery traffic
     compiles zero new XLA programs."""
-    from fks_tpu.obs import CompileWatcher
+    from fks_tpu.obs import CompileWatcher, FlightRecorder
     from fks_tpu.pipeline.faults import FlakyEngineProxy
     from fks_tpu.serve import ServeService
 
     flaky = FlakyEngineProxy(stack.incumbent, failures=1)
-    service = ServeService(flaky, max_wait_s=0.002)
-    mgr = service.enable_degraded_mode(
-        lambda: _fallback_engine(stack),
-        rebuild_factory=lambda: stack.incumbent,
-        config=DegradeConfig(probation_requests=2,
-                             background_rebuild=False))
-    try:
-        drift = _degrade_traffic_parity(stack, service, 2)  # fault + flip
-        # the inline rebuild already finished; the next batch promotes it
-        # into probation and the one after releases probation. Only the
-        # SERVED path sits under the watcher — the parity reference is
-        # computed after it (reference_answer compiles its own programs)
-        watcher = CompileWatcher().install()
+    with tempfile.TemporaryDirectory(prefix="fks_drill_") as tmp:
+        # drill-local recorder: the primary-fail -> fallback-retry chain
+        # must come back as ONE connected trace per request
+        rec = FlightRecorder(tmp)
+        service = ServeService(flaky, max_wait_s=0.002, recorder=rec)
+        mgr = service.enable_degraded_mode(
+            lambda: _fallback_engine(stack),
+            rebuild_factory=lambda: stack.incumbent,
+            config=DegradeConfig(probation_requests=2,
+                                 background_rebuild=False))
         try:
-            answers = stack.traffic(service, 4)
-            recompiles = watcher.backend_compile_count
+            drift = _degrade_traffic_parity(stack, service, 2)  # fault+flip
+            # the inline rebuild already finished; the next batch promotes
+            # it into probation and the one after releases probation. Only
+            # the SERVED path sits under the watcher — the parity reference
+            # is computed after it (reference_answer compiles its own
+            # programs)
+            watcher = CompileWatcher().install()
+            try:
+                answers = stack.traffic(service, 4)
+                recompiles = watcher.backend_compile_count
+            finally:
+                watcher.uninstall()
+            drift = max(drift, _traffic_drift(stack, answers))
+            hz = mgr.healthz()
         finally:
-            watcher.uninstall()
-        drift = max(drift, _traffic_drift(stack, answers))
-        hz = mgr.healthz()
+            service.close()
+            rec.finish("ok")
+            rec.close()
+        traces = _connected_traces(tmp)
         return {"ok": (hz["state"] == "normal" and hz["recoveries"] == 1
                        and hz["flips"] == 1 and recompiles == 0
                        and service.engine is stack.incumbent
-                       and drift == 0.0),
+                       and drift == 0.0 and traces["traces_ok"]),
                 "state": hz["state"], "recoveries": hz["recoveries"],
                 "post_recovery_recompiles": recompiles,
-                "parity_drift": drift}
-    finally:
-        service.close()
+                "parity_drift": drift, **traces}
 
 
 def _drill_sigterm_drain(stack) -> Dict[str, Any]:
@@ -320,15 +353,24 @@ def _drill_device_loss_sharded_serve(stack) -> Dict[str, Any]:
                           mesh=population_mesh(jax.devices()))
         eng.warmup()
         stack._resilience_sharded = eng
+    from fks_tpu.obs import FlightRecorder
+
     flaky = FlakyEngineProxy(stack._resilience_sharded, failures=1)
-    service = ServeService(flaky, max_wait_s=0.002)
-    service.enable_degraded_mode(
-        lambda: _fallback_engine(stack),
-        config=DegradeConfig(background_rebuild=False))
-    try:
-        drift = _degrade_traffic_parity(stack, service, 3)
-        follow_up = stack.traffic(service, 2)  # batcher still alive
-        degrade = service.degrade.healthz()
+    with tempfile.TemporaryDirectory(prefix="fks_drill_") as tmp:
+        rec = FlightRecorder(tmp)
+        service = ServeService(flaky, max_wait_s=0.002, recorder=rec)
+        service.enable_degraded_mode(
+            lambda: _fallback_engine(stack),
+            config=DegradeConfig(background_rebuild=False))
+        try:
+            drift = _degrade_traffic_parity(stack, service, 3)
+            follow_up = stack.traffic(service, 2)  # batcher still alive
+            degrade = service.degrade.healthz()
+        finally:
+            service.close()
+            rec.finish("ok")
+            rec.close()
+        traces = _connected_traces(tmp)
         return {"ok": (flaky.faults_raised == 1
                        and degrade["state"] == "degraded"
                        and degrade["flips"] == 1
@@ -336,13 +378,12 @@ def _drill_device_loss_sharded_serve(stack) -> Dict[str, Any]:
                        and service.engine is _fallback_engine(stack)
                        and drift == 0.0
                        and len(follow_up) == 2
-                       and all("score" in a for a in follow_up)),
+                       and all("score" in a for a in follow_up)
+                       and traces["traces_ok"]),
                 "state": degrade["state"], "flips": degrade["flips"],
                 "parity_drift": drift,
                 "mesh_devices": len(jax.devices()),
-                "follow_up_answers": len(follow_up)}
-    finally:
-        service.close()
+                "follow_up_answers": len(follow_up), **traces}
 
 
 RESILIENCE_DRILLS = (

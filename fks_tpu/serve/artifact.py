@@ -33,6 +33,7 @@ import glob
 import hashlib
 import json
 import os
+import time
 import warnings
 from collections import OrderedDict
 from typing import Any, Dict, List, NamedTuple, Optional, Sequence, Tuple
@@ -368,6 +369,12 @@ class ServeEngine:
         # H2D accounting (bytes actually shipped per answered query)
         self.h2d_bytes_total = 0
         self.h2d_queries = 0
+        # host-wall split of the last answer_batch call (pack+upload vs
+        # dispatch+harvest) — the serve-request waterfall's engine stages
+        # (fks_tpu.serve.service). Plain perf_counter stamps around work
+        # the engine already does: zero new fences, zero device effects.
+        self.last_batch_timing: Dict[str, float] = {
+            "pack_h2d_s": 0.0, "dispatch_s": 0.0}
 
         n, g = self.cluster.n_padded, self.cluster.g_padded
         self.param_policy, self.params, self.policy_tier = \
@@ -599,6 +606,7 @@ class ServeEngine:
         for pods in pod_lists:
             validate_query_pods(pods, max_pods=self.envelope.max_pods,
                                 max_gpu_milli=self.envelope.max_gpu_milli)
+        self.last_batch_timing = {"pack_h2d_s": 0.0, "dispatch_s": 0.0}
         answers: List[Optional[dict]] = [None] * len(pod_lists)
         groups: Dict[int, List[int]] = {}
         for i, pods in enumerate(pod_lists):
@@ -622,6 +630,7 @@ class ServeEngine:
         """Stack + pack + upload one chunk and dispatch it (async): the
         h2d profiler stage covers exactly the bytes shipped; execution
         cost lands in ``_harvest``'s steady stage."""
+        t0 = time.perf_counter()
         lanes = self._global_lanes(len(idxs))
         cfg = self.bucket_config(bucket)
         pods, kt, s0 = stack_query_tables(
@@ -640,11 +649,13 @@ class ServeEngine:
             hh.sync(jax.tree_util.tree_leaves(s0)[0])
         self.h2d_queries += len(idxs)
         res = compiled(pods, kt_dev, s0)  # async dispatch; buffers donated
+        self.last_batch_timing["pack_h2d_s"] += time.perf_counter() - t0
         return _Inflight(res, list(idxs), bucket, lanes, real)
 
     def _harvest(self, inflight: "_Inflight", pod_lists, answers) -> None:
         """Block on a dispatched chunk and scatter its answers back."""
         res, idxs, bucket, lanes, real = inflight
+        t0 = time.perf_counter()
         with self.profiler.stage("steady", **occupancy_stats(real, lanes)) \
                 as hs:
             with obs.span("serve_batch", lanes=lanes, bucket_pods=bucket,
@@ -652,6 +663,7 @@ class ServeEngine:
                 t.sync(res.policy_score)
             hs.sync(res.policy_score)
         res = jax.device_get(res)
+        self.last_batch_timing["dispatch_s"] += time.perf_counter() - t0
         for lane, i in enumerate(idxs):
             answers[i] = self._extract(res, lane, len(pod_lists[i]),
                                        bucket, lanes)

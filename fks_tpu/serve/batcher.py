@@ -36,9 +36,12 @@ import jax.numpy as jnp
 import numpy as np
 
 from fks_tpu.data.entities import PodArrays, Workload
+from fks_tpu.obs import trace_ctx
 from fks_tpu.parallel.traces import strip_ids
 from fks_tpu.resilience.admission import AdmissionConfig, AdmissionController
-from fks_tpu.resilience.deadline import Deadline, DeadlineExceeded, ShedError
+from fks_tpu.resilience.deadline import (
+    Deadline, DeadlineExceeded, ResilienceError, ShedError,
+)
 from fks_tpu.sim.evaluator import max_snapshot_count, snapshot_trigger_table
 
 #: query pod schema — the reference entity field names (simulator/
@@ -276,6 +279,27 @@ def pods_to_dicts(pods: PodArrays, limit: Optional[int] = None) -> List[dict]:
     return [{k: int(v[i]) for k, v in cols.items()} for i in idx]
 
 
+class QueuedRequest:
+    """One queued submit: the query, its Future, its timestamps, and —
+    when tracing is on — the caller's ``TraceContext``, carried OBJECT-
+    in-hand across the submit-thread -> worker-thread boundary (the hop
+    where thread-local span nesting loses causality)."""
+
+    __slots__ = ("query", "fut", "t_enq", "deadline", "ctx", "t_deq")
+
+    def __init__(self, query, fut, t_enq, deadline, ctx):
+        self.query = query
+        self.fut = fut
+        self.t_enq = t_enq
+        self.deadline = deadline
+        self.ctx = ctx
+        self.t_deq = t_enq  # stamped by the worker at dequeue
+
+    @property
+    def trace_id(self):
+        return self.ctx.trace_id if self.ctx is not None else None
+
+
 class RequestBatcher:
     """Flush-policy request coalescer over a synchronous batch handler.
 
@@ -300,7 +324,15 @@ class RequestBatcher:
       resolve through one ``_complete`` funnel;
     - ``drain()`` is the SIGTERM path: stop admitting, give the worker a
       grace budget to finish real work, then shed whatever remains with
-      a typed error so no client ever hangs on a dying server."""
+      a typed error so no client ever hangs on a dying server.
+
+    Tracing hooks (fks_tpu.obs.trace_ctx): ``submit(..., ctx=)`` (or the
+    submitting thread's active context) rides the ``QueuedRequest`` to
+    the worker; every typed error raised or completed for a traced
+    request carries its ``trace_id`` (so 503 bodies correlate to the
+    flight-recorder trail), and the handler can read the in-flight
+    requests' contexts/timestamps via ``inflight()`` to emit per-request
+    waterfall spans."""
 
     def __init__(self, handle_batch: Callable[[list, list], list],
                  max_batch: int = 8, max_wait_s: float = 0.005,
@@ -330,30 +362,47 @@ class RequestBatcher:
         self._closed = False
         self._draining = False
         self._shed_mode = False  # grace exhausted: flush = shed, not run
+        self._inflight: Sequence[QueuedRequest] = ()
         self._thread = threading.Thread(
             target=self._loop, name="serve-batcher", daemon=True)
         self._thread.start()
 
-    def submit(self, query, deadline: Optional[Deadline] = None) -> Future:
+    def submit(self, query, deadline: Optional[Deadline] = None,
+               ctx: Optional[trace_ctx.TraceContext] = None) -> Future:
+        if ctx is None:  # inherit the submitting thread's trace, if any
+            ctx = trace_ctx.current()
+        tid = ctx.trace_id if ctx is not None else None
         if self._draining:  # before the closed check: drain() sets both,
             # and a drained server sheds with a TYPED error
             self.shed_draining += 1
             self.recorder.event("shed", reason="draining",
-                                queue_depth=self.admission.depth)
-            raise ShedError("server is draining", reason="draining")
+                                queue_depth=self.admission.depth,
+                                **({"trace_id": tid} if tid else {}))
+            raise ShedError("server is draining", reason="draining",
+                            trace_id=tid)
         if self._closed:
             raise RuntimeError("batcher is closed")
         try:
             self.admission.admit(deadline)
         except ShedError as e:
+            e.trace_id = tid
             self.recorder.event("shed", reason=e.reason,
                                 queue_depth=self.admission.depth,
-                                retry_after_s=e.retry_after_s)
+                                retry_after_s=e.retry_after_s,
+                                **({"trace_id": tid} if tid else {}))
             raise
         self.submitted += 1
         fut: Future = Future()
-        self._q.put((query, fut, time.perf_counter(), deadline))
+        self._q.put(QueuedRequest(query, fut, time.perf_counter(),
+                                  deadline, ctx))
         return fut
+
+    def inflight(self) -> Sequence[QueuedRequest]:
+        """The requests of the batch currently inside the handler (their
+        contexts + enqueue/dequeue stamps) — read by the handler itself
+        to emit per-request waterfall spans. Empty outside a handler
+        call; only meaningful ON the worker thread."""
+        return self._inflight
 
     def close(self) -> None:
         if self._closed:
@@ -410,11 +459,11 @@ class RequestBatcher:
         return True
 
     def _loop(self) -> None:
-        pending: list = []
+        pending: List[QueuedRequest] = []
         while True:
             timeout = None
             if pending:
-                waited = time.perf_counter() - pending[0][2]
+                waited = time.perf_counter() - pending[0].t_enq
                 timeout = max(0.0, self.max_wait_s - waited)
             try:
                 item = self._q.get(timeout=timeout)
@@ -425,53 +474,59 @@ class RequestBatcher:
             if item is None:  # close/drain sentinel
                 self._flush(pending)
                 return
+            item.t_deq = time.perf_counter()
             pending.append(item)
             if len(pending) >= self.max_batch:
                 self._flush(pending)
                 pending = []
 
-    def _flush(self, pending: list) -> None:
+    def _flush(self, pending: List[QueuedRequest]) -> None:
         if not pending:
             return
         self.admission.release(len(pending))
         if self._shed_mode:  # drain grace exhausted: typed shed, no work
-            for _, fut, _, _ in pending:
-                if self._complete(fut, exc=ShedError(
-                        "server shut down before this request ran")):
+            for r in pending:
+                if self._complete(r.fut, exc=ShedError(
+                        "server shut down before this request ran",
+                        trace_id=r.trace_id)):
                     self.shed_inflight += 1
             return
-        live: list = []
-        for entry in pending:
-            _, fut, _, deadline = entry
-            if deadline is not None and deadline.expired():
-                if self._complete(fut, exc=DeadlineExceeded(
-                        "deadline expired while queued")):
+        live: List[QueuedRequest] = []
+        for r in pending:
+            if r.deadline is not None and r.deadline.expired():
+                if self._complete(r.fut, exc=DeadlineExceeded(
+                        "deadline expired while queued",
+                        trace_id=r.trace_id)):
                     self.expired += 1
                     self.admission.note_expired()
             else:
-                live.append(entry)
+                live.append(r)
         if not live:
             return
         self.batches += 1
         self._occupancy_sum += len(live) / self.max_batch
-        queries = [q for q, _, _, _ in live]
-        enq = [t for _, _, t, _ in live]
+        queries = [r.query for r in live]
+        enq = [r.t_enq for r in live]
         t0 = time.perf_counter()
+        self._inflight = live
         try:
             answers = self._handle(queries, enq)
         except Exception as e:  # noqa: BLE001 — fail the batch, not the loop
-            for _, fut, _, _ in live:
-                self._complete(fut, exc=e)
+            for r in live:
+                self._complete(r.fut, exc=e)
             return
+        finally:
+            self._inflight = ()
         self.admission.note_batch(len(live), time.perf_counter() - t0)
         answers = list(answers)
-        for i, (_, fut, _, _) in enumerate(live):
+        for i, r in enumerate(live):
             if i < len(answers):
-                if self._complete(fut, result=answers[i]):
+                if self._complete(r.fut, result=answers[i]):
                     self.completed += 1
             else:
                 # a short answer list must FAIL the unmatched futures,
                 # never leave them hanging (the old zip() bug)
-                self._complete(fut, exc=RuntimeError(
+                self._complete(r.fut, exc=ResilienceError(
                     f"batch handler returned {len(answers)} answers for "
-                    f"{len(live)} queries"))
+                    f"{len(live)} queries", reason="short_answer",
+                    trace_id=r.trace_id))

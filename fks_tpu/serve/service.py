@@ -24,6 +24,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from fks_tpu import obs
+from fks_tpu.obs import trace_ctx
 from fks_tpu.obs.history import SLOConfig, record_slo_burn
 from fks_tpu.obs.watchdog import ParitySentinel
 from fks_tpu.resilience.deadline import Deadline, ResilienceError
@@ -172,7 +173,12 @@ class ServeService:
         full / deadline unmeetable / draining)."""
         rid, pods = self.resolve_query(query)
         deadline = Deadline.from_query(query, self.default_deadline_s)
-        return self._batcher.submit((rid, pods), deadline=deadline)
+        # every admitted request starts ONE causal trace; the context
+        # object rides the queue to the batcher thread (null path: no
+        # recorder -> no context is ever allocated)
+        ctx = (trace_ctx.new_trace()
+               if getattr(self.recorder, "enabled", False) else None)
+        return self._batcher.submit((rid, pods), deadline=deadline, ctx=ctx)
 
     def close(self) -> None:
         self._batcher.close()
@@ -210,24 +216,35 @@ class ServeService:
         # swap ``self.engine`` concurrently, and a batch must be answered
         # (and audited) by ONE engine end to end
         engine = self.engine
+        t_start = time.perf_counter()
+        fault: Optional[Tuple[BaseException, float]] = None
         try:
             answers = engine.answer_batch([pods for _, pods in items])
         except Exception as e:  # noqa: BLE001 — maybe a device fault
+            t_fail = time.perf_counter()
             if self._degrade is None or not self._degrade.on_fault(e):
                 raise
             # the manager flipped us to the fallback engine: retry the
-            # batch there (re-pin — swap_engine already landed)
+            # batch there (re-pin — swap_engine already landed); the
+            # failed primary attempt stays on each request's trace
+            fault = (e, t_fail - t_start)
             engine = self.engine
             answers = engine.answer_batch([pods for _, pods in items])
         done = time.perf_counter()
+        inflight = self._batcher.inflight()
+        self._trace_batch(engine, inflight, t_start, done, fault)
         if self._t_first is None:
             self._t_first = min(enq_times)
         self._t_last = done
         occupancy = len(items) / self._batcher.max_batch
-        for (rid, pods), enq, ans in zip(items, enq_times, answers):
+        for i, ((rid, pods), enq, ans) in enumerate(
+                zip(items, enq_times, answers)):
             latency_ms = (done - enq) * 1e3
             ans["id"] = rid
             ans["latency_ms"] = round(latency_ms, 3)
+            tid = inflight[i].trace_id if i < len(inflight) else None
+            if tid:
+                ans["trace_id"] = tid
             self._replay.append(pods)
             self._latencies_ms.append(latency_ms)
             self.recorder.metric(
@@ -235,7 +252,8 @@ class ServeService:
                 latency_ms=round(latency_ms, 3), batch_size=len(items),
                 batch_occupancy=round(occupancy, 4),
                 bucket_pods=ans["bucket_pods"],
-                bucket_lanes=ans["bucket_lanes"])
+                bucket_lanes=ans["bucket_lanes"],
+                **({"trace_id": tid} if tid else {}))
             if self.audit_every > 0 and \
                     len(self._latencies_ms) % self.audit_every == 0:
                 self._audit(engine, rid, pods, ans)
@@ -248,6 +266,58 @@ class ServeService:
         if self._degrade is not None:
             self._degrade.after_batch(len(items))
         return answers
+
+    def _trace_batch(self, engine: ServeEngine, inflight, t_start: float,
+                     done: float, fault) -> None:
+        """Per-request latency waterfalls: one ``serve/request`` root plus
+        queue_wait / batch_wait / pack_h2d / dispatch / scatter_back
+        children for every traced request of the batch just answered.
+
+        All spans are written after the fact with EXPLICIT end
+        timestamps (``ts`` override), so reconstruction places each bar
+        where the work actually happened. The engine-stage split reuses
+        the host-wall decomposition the engine already measures
+        (``last_batch_timing``); the batch-level pack/dispatch costs are
+        shared by every lane, so each request reports the same split —
+        the truthful statement for a coalesced batch. A degraded-mode
+        retry adds a ``primary_attempt`` child carrying the fault class,
+        linking primary-fail -> fallback-retry on ONE trace."""
+        if not getattr(self.recorder, "enabled", False):
+            return
+        timing = getattr(engine, "last_batch_timing", None) or {}
+        pack_s = float(timing.get("pack_h2d_s", 0.0))
+        disp_s = float(timing.get("dispatch_s", 0.0))
+        retry_s = fault[1] if fault is not None else 0.0
+        scatter_s = max((done - t_start) - retry_s - pack_s - disp_s, 0.0)
+        wall_done = time.time()
+
+        def _ts(perf_t: float) -> float:
+            # perf_counter point -> wall-clock event timestamp
+            return wall_done - (done - perf_t)
+
+        rec = self.recorder
+        t_run = t_start + retry_s  # successful attempt began here
+        for r in inflight:
+            ctx = r.ctx
+            if ctx is None:
+                continue
+            t_deq = min(max(r.t_deq, r.t_enq), t_start)
+            trace_ctx.emit(rec, trace_ctx.SERVE_ROOT, done - r.t_enq,
+                           ctx=ctx, root=True, ts=_ts(done))
+            trace_ctx.emit(rec, "serve/request/queue_wait",
+                           t_deq - r.t_enq, ctx=ctx, ts=_ts(t_deq))
+            trace_ctx.emit(rec, "serve/request/batch_wait",
+                           t_start - t_deq, ctx=ctx, ts=_ts(t_start))
+            if fault is not None:
+                trace_ctx.emit(rec, "serve/request/primary_attempt",
+                               retry_s, ctx=ctx, ts=_ts(t_run),
+                               fault=type(fault[0]).__name__)
+            trace_ctx.emit(rec, "serve/request/pack_h2d", pack_s,
+                           ctx=ctx, ts=_ts(t_run + pack_s))
+            trace_ctx.emit(rec, "serve/request/dispatch", disp_s,
+                           ctx=ctx, ts=_ts(t_run + pack_s + disp_s))
+            trace_ctx.emit(rec, "serve/request/scatter_back", scatter_s,
+                           ctx=ctx, ts=_ts(done))
 
     def _audit(self, engine: ServeEngine, rid: str, pods: List[dict],
                ans: dict) -> None:
@@ -441,7 +511,13 @@ def selftest(engine: ServeEngine, count: int = 8, pods_per_query: int = 4,
     the pinned workload's real pods (sliding windows, so queries differ),
     answered through the batched warm path and re-answered one-by-one by
     the unbatched exact engine. The serve gate's contract: every score
-    within ``tol``, every placement list identical."""
+    within ``tol``, every placement list identical.
+
+    The batched pass runs through a real ``ServeService`` (submit ->
+    coalescer -> handler), not a bare ``answer_batch`` call, so every
+    selftest request exercises — and, under a flight recorder, TRACES —
+    the same path production requests take (the run_full_suite trace
+    gate reconstructs a complete waterfall per request from this)."""
     base = engine.base_pods
     if not base:  # artifact pinned with an empty trace — synthesize
         base = [{"cpu_milli": 1 + i, "memory_mib": 1, "creation_time": i,
@@ -451,7 +527,11 @@ def selftest(engine: ServeEngine, count: int = 8, pods_per_query: int = 4,
         start = i % max(1, len(base) - pods_per_query + 1)
         q = base[start:start + pods_per_query]
         queries.append(q if q else base[:1])
-    batched = engine.answer_batch(queries)
+    service = ServeService(engine, max_wait_s=0.002)
+    futures = [service.submit({"id": f"selftest-{i:03d}", "pods": q})
+               for i, q in enumerate(queries)]
+    service.close()  # flush the tail batch; every Future resolves
+    batched = [f.result() for f in futures]
     max_drift = 0.0
     placements_ok = True
     failures = []

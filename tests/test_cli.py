@@ -193,7 +193,8 @@ def test_evolve_run_dir_then_report_smoke(micro_cli, tmp_path, capsys):
         assert key in gens[0], key
     kinds = {json.loads(l)["kind"] for l
              in (run_dir / "events.jsonl").read_text().splitlines()}
-    assert "span" in kinds and "device" in kinds
+    # evolve spans now run under a generation trace ctx -> trace_span
+    assert "trace_span" in kinds and "device" in kinds
     assert "compile" in kinds  # jax.monitoring listener captured compiles
 
     rc = cli.main(["report", str(run_dir)])

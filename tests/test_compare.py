@@ -205,6 +205,10 @@ def _live_run(tmp_path, heartbeat_age, gap=10.0):
         "".join(json.dumps(r) + "\n" for r in rows))
     (d / "heartbeat").write_text(json.dumps(
         {"ts": now - heartbeat_age, "run_id": "live"}))
+    # a genuinely stale heartbeat is old on BOTH signals: the embedded
+    # ts and the file mtime (run_health takes the fresher of the two so
+    # writer/reader clock skew cannot flap a live run to STALE)
+    os.utime(d / "heartbeat", (now - heartbeat_age, now - heartbeat_age))
     return str(d)
 
 
@@ -218,6 +222,15 @@ def test_run_health_states(tmp_path):
     no_beat = _live_run(tmp_path, 1.0, gap=10.0)
     os.remove(os.path.join(no_beat, "heartbeat"))
     assert run_health(no_beat)["state"] == "DEAD"
+
+
+def test_run_health_monotonic_skew_guard(tmp_path):
+    """A heartbeat whose embedded ts looks old but whose file was just
+    modified (writer/reader clock skew, shared-filesystem lag) must NOT
+    flap to STALE/DEAD — the fresher of the two signals wins."""
+    d = _live_run(tmp_path, 60.0)
+    os.utime(os.path.join(d, "heartbeat"), None)  # mtime = now
+    assert run_health(d)["state"] == "HEALTHY"
 
 
 def test_report_flags_stale_run(tmp_path):

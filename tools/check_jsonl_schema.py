@@ -82,6 +82,11 @@ EVENT_KIND_REQUIRED: Dict[str, Tuple[str, ...]] = {
     # evolve WAL replay: a resumed generation reused persisted
     # candidates/evals instead of re-spending LLM calls / device evals
     "resume_wal": ("generation",),
+    # causal tracing (fks_tpu.obs.trace_ctx): one span of a request /
+    # generation / promotion trace. parent_id is intentionally NOT
+    # required: the root span carries an explicit JSON null there, and
+    # key-presence is what this checker tests
+    "trace_span": ("trace_id", "span_id", "path", "seconds"),
 }
 
 #: legal ``taxonomy`` values on a candidate_rejected event. This tool is
@@ -144,12 +149,17 @@ METRIC_KIND_REQUIRED: Dict[str, Tuple[str, ...]] = {
                        "h2d_bytes_per_query"),
 }
 
-#: an OpenMetrics sample line: name, optional {labels}, value, optional ts
+#: an OpenMetrics sample line: name, optional {labels}, value, optional
+#: ts, optional exemplar (`# {labels} value [ts]` — carried on histogram
+#: buckets by the exporter's latency family to link hot buckets back to
+#: a trace id)
+_LABELSET = (r'\{[a-zA-Z_][a-zA-Z0-9_]*="(\\.|[^"\\])*"'
+             r'(,[a-zA-Z_][a-zA-Z0-9_]*="(\\.|[^"\\])*")*\}')
 _SAMPLE_RE = re.compile(
     r'^[a-zA-Z_:][a-zA-Z0-9_:]*'                 # metric name
-    r'(\{[a-zA-Z_][a-zA-Z0-9_]*="(\\.|[^"\\])*"'  # first label
-    r'(,[a-zA-Z_][a-zA-Z0-9_]*="(\\.|[^"\\])*")*\})?'  # more labels
-    r' -?[0-9.eE+-]+( [0-9.eE+-]+)?$')
+    rf'({_LABELSET})?'                           # labels
+    r' -?[0-9.eE+-]+( [0-9.eE+-]+)?'             # value, optional ts
+    rf'( # {_LABELSET} -?[0-9.eE+-]+( [0-9.eE+-]+)?)?$')  # exemplar
 
 
 class SchemaError(ValueError):

@@ -53,6 +53,16 @@ none (exit 0). A miss either way means the robust-z change-point pass
 is broken — its alerts on the real archive would be noise or silence.
 Recorded as ``trends_gate``. Pure-host (no jax import needed).
 
+A SPAN TRACE GATE follows: a recorded ``cli serve --selftest`` run must
+yield a COMPLETE causal waterfall (queue_wait / batch_wait / pack_h2d /
+dispatch / scatter_back under one root) for 100% of its served requests
+(``cli spans <dir> --check-complete``), and a recorded 1-generation
+fake-LLM evolve must attribute >= 95% of the generation wall to traced
+stages (``cli spans <dir> --critical-path --min-fraction 0.95``). A
+failure means the trace-context propagation across the batcher / evolve
+threads tore somewhere — per-request waterfalls and critical-path
+attribution would silently lie. Recorded as ``span_trace_gate``.
+
 A RESILIENCE GATE follows: the deterministic resilience drills
 (deadline storm, queue overload, device loss mid-batch,
 degrade-then-recover, SIGTERM drain, WAL resume mid-generation) from
@@ -196,6 +206,43 @@ def promote_gate() -> dict:
     return {"ok": ok, **detail}
 
 
+def span_trace_gate() -> dict:
+    """Causal-trace completeness: a recorded serve selftest must produce
+    a complete waterfall for every served request, and a recorded 1-gen
+    fake-LLM evolve must attribute >= 95% of the generation wall to
+    traced stages. Returns {"ok": bool, ...}."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    detail = {}
+    ok = True
+    with tempfile.TemporaryDirectory() as tmp:
+        serve_dir = os.path.join(tmp, "serve")
+        evolve_dir = os.path.join(tmp, "evolve")
+        steps = (
+            ("serve", [sys.executable, "-m", "fks_tpu.cli", "serve",
+                       "--cpu", "--selftest", "4", "--pods-per-query", "3",
+                       "--max-pods", "16", "--max-batch", "4",
+                       "--run-dir", serve_dir]),
+            ("serve_waterfalls", [sys.executable, "-m", "fks_tpu.cli",
+                                  "spans", serve_dir, "--check-complete"]),
+            ("evolve", [sys.executable, "-m", "fks_tpu.cli", "evolve",
+                        "--cpu", "--fake-llm", "--generations", "1",
+                        "--run-dir", evolve_dir]),
+            ("critical_path", [sys.executable, "-m", "fks_tpu.cli",
+                               "spans", evolve_dir, "--critical-path",
+                               "--min-fraction", "0.95"]),
+        )
+        for name, cmd in steps:
+            proc = subprocess.run(cmd, capture_output=True, text=True,
+                                  cwd=REPO, env=env, timeout=900)
+            detail[f"{name}_rc"] = proc.returncode
+            if proc.returncode != 0:
+                ok = False
+                detail[f"{name}_err"] = (proc.stderr
+                                         or proc.stdout or "")[-500:]
+                break
+    return {"ok": ok, **detail}
+
+
 def resilience_gate() -> dict:
     """Resilience-drill matrix: the deterministic failure drills from
     fks_tpu/resilience/drills.py (deadline storm, queue overload, device
@@ -289,6 +336,9 @@ def main() -> int:
     rgate = resilience_gate()
     if not rgate["ok"]:
         print(f"RESILIENCE GATE FAILED: {rgate}", file=sys.stderr)
+    wgate = span_trace_gate()
+    if not wgate["ok"]:
+        print(f"SPAN TRACE GATE FAILED: {wgate}", file=sys.stderr)
     t0 = time.time()
     proc = subprocess.run(
         [sys.executable, "-m", "pytest", "tests/", "-q",
@@ -301,14 +351,15 @@ def main() -> int:
         r"(\d+) (passed|failed|error|skipped|deselected|xfailed)", summary)}
     gates_ok = (gate["ok"] and tgate["ok"] and sgate["ok"] and vgate["ok"]
                 and hgate["ok"] and lgate["ok"] and ngate["ok"]
-                and pgate["ok"] and rgate["ok"])
+                and pgate["ok"] and rgate["ok"] and wgate["ok"])
     rc = proc.returncode if gates_ok else (proc.returncode or 1)
     row = {"ts": round(time.time(), 1), "rev": rev, "rc": rc,
            "wall_s": wall, **counts, "obs_gate": gate,
            "trace_gate": tgate, "scale_gate": sgate, "serve_gate": vgate,
            "sharded_serve_gate": hgate, "lint_gate": lgate,
            "trends_gate": ngate, "promote_gate": pgate,
-           "resilience_gate": rgate, "summary": summary}
+           "resilience_gate": rgate, "span_trace_gate": wgate,
+           "summary": summary}
     os.makedirs(os.path.dirname(OUT), exist_ok=True)
     with open(OUT, "a") as f:
         f.write(json.dumps(row) + "\n")
