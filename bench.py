@@ -1472,6 +1472,17 @@ def stage_promote(gate: str = "") -> int:
     - ``post_swap_recompiles``: backend compiles while serving live
       traffic on the freshly promoted engine — gated at 0 (the swap
       must inherit a fully warm ladder).
+
+    Then the same promotion on the VM-native engine, head to head:
+
+    - ``promotion_rebuild_s``: what the AOT flow pays off-path to bind
+      a champion — the full bucket-ladder rebuild inside the factory;
+    - ``promotion_swap_ms``: what the VM flow pays instead — transpile
+      + pack + H2D upload into the resident executables;
+    - ``vm_swap_h2d_bytes``: the entire device traffic of that swap;
+    - ``vm_promote_compiles``: backend compiles across the VM
+      promotion AND post-swap traffic — gated at 0 (the whole point:
+      promotion never touches XLA).
     """
     import tempfile
 
@@ -1486,6 +1497,7 @@ def stage_promote(gate: str = "") -> int:
     )
     from fks_tpu.serve import (
         ChampionSpec, ServeEngine, ServeService, ShapeEnvelope,
+        VMServeEngine,
     )
 
     global _RECORDER
@@ -1518,6 +1530,16 @@ def stage_promote(gate: str = "") -> int:
     ctrl = PromotionController(
         service, wl, ledger_dir=tmp,
         config=PromotionConfig(shadow_queries=4))
+    rebuild = {"s": 0.0}
+    aot_factory = ctrl._factory
+
+    def timed_factory(champ):
+        tb = time.perf_counter()
+        eng = aot_factory(champ)
+        rebuild["s"] = time.perf_counter() - tb
+        return eng
+
+    ctrl._factory = timed_factory
     t0 = time.perf_counter()
     verdict = ctrl.poll_once()
     shadow_s = time.perf_counter() - t0
@@ -1527,8 +1549,45 @@ def stage_promote(gate: str = "") -> int:
     recompiles = watcher.backend_compile_count - marks
     service.close()
     log(f"promote stage: {verdict.get('action')} in {shadow_s:.2f}s, "
-        f"swap {ctrl.last_swap_ms:.3f}ms, post-swap recompiles "
-        f"{recompiles}")
+        f"rebuild {rebuild['s']:.2f}s, swap {ctrl.last_swap_ms:.3f}ms, "
+        f"post-swap recompiles {recompiles}")
+
+    # --- the VM-native flow: same promotion, zero-rebuild hot path
+    vm_inc = VMServeEngine(
+        ChampionSpec(code=template.fill_template("score = 1000"),
+                     score=0.4, source="<bench-seed>"),
+        wl, envelope=envelope, engine="flat")
+    vm_inc.warmup()
+    vm_service = ServeService(vm_inc, max_wait_s=0.002)
+    vm_base = vm_inc.base_pods
+
+    def vm_traffic(n: int) -> None:
+        futs = [vm_service.submit(
+            {"pods": [dict(vm_base[(i + j) % len(vm_base)])
+                      for j in range(3)]})
+            for i in range(n)]
+        for f in futs:
+            f.result(timeout=300)
+
+    vm_traffic(8)
+    vm_tmp = tempfile.mkdtemp(prefix="fks_promote_vm_")
+    write_champion(vm_tmp, template.fill_template(candidate), 0.9,
+                   name="bench-vm")
+    vm_ctrl = PromotionController(
+        vm_service, wl, ledger_dir=vm_tmp,
+        config=PromotionConfig(shadow_queries=4))
+    vm_marks = watcher.backend_compile_count
+    vm_verdict = vm_ctrl.poll_once()
+    vm_traffic(8)  # warm path on the swapped-in program
+    vm_compiles = watcher.backend_compile_count - vm_marks
+    vm_promoted = (vm_verdict.get("action") == "promoted"
+                   and vm_verdict.get("engine_kind") == "vm")
+    swap = dict(vm_inc.last_swap_breakdown)
+    vm_service.close()
+    log(f"promote stage (vm): {vm_verdict.get('action')} "
+        f"kind={vm_verdict.get('engine_kind')}, swap "
+        f"{swap.get('swap_ms', 0.0):.3f}ms "
+        f"(h2d {swap.get('h2d_bytes', 0)}B), compiles {vm_compiles}")
 
     payload = {
         "promote_swap_ms": ctrl.last_swap_ms,
@@ -1538,6 +1597,13 @@ def stage_promote(gate: str = "") -> int:
         "post_swap_recompiles": recompiles,
         "promoted": int(promoted),
         "backend_compiles": watcher.backend_compile_count,
+        "promotion_rebuild_s": round(rebuild["s"], 3),
+        "promotion_swap_ms": float(swap.get("swap_ms", 0.0)),
+        "vm_swap_h2d_bytes": int(swap.get("h2d_bytes", 0)),
+        "vm_swap_transpile_ms": float(swap.get("transpile_ms", 0.0)),
+        "vm_swap_upload_ms": float(swap.get("h2d_ms", 0.0)),
+        "vm_promote_compiles": vm_compiles,
+        "vm_promoted": int(vm_promoted),
         "nodes": nodes, "engine": "flat",
     }
     _record("metric", "bench_stage", payload, stage="promote",
@@ -1549,6 +1615,13 @@ def stage_promote(gate: str = "") -> int:
     if recompiles:
         log(f"FAIL: {recompiles} recompiles after the swap — the shadow "
             "ladder was not fully warm")
+        rc = 1
+    if not vm_promoted:
+        log(f"FAIL: VM fast path did not promote: {vm_verdict}")
+        rc = 1
+    if vm_compiles:
+        log(f"FAIL: {vm_compiles} backend compiles across the VM "
+            "promotion — the swap must be rebuild-free")
         rc = 1
     if gate:
         rc = rc or _gate(gate, payload)

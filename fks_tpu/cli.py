@@ -635,25 +635,45 @@ def cmd_serve(args):
                 return 2
             champion = load_champion(champ_path)
             _, wl = _parse_workload(args)
-            engine = ServeEngine(
-                champion, wl,
+            build_kw = dict(
                 envelope=ShapeEnvelope(max_pods=args.max_pods,
                                        max_batch=args.max_batch),
                 engine=args.engine,
                 prefilter_k=getattr(args, "prefilter_k", None),
                 state_pack=getattr(args, "state_pack", False),
                 mesh=mesh, recorder=rec)
+            engine = None
+            if getattr(args, "serve_engine", "aot") == "vm":
+                from fks_tpu.funsearch.vm import VMUnsupported
+                from fks_tpu.serve import VMServeEngine
+                try:
+                    engine = VMServeEngine(champion, wl, **build_kw)
+                except VMUnsupported as e:
+                    # coverage gap, not an error: serve it on the exact
+                    # AOT closure engine and say so (the recorded event
+                    # is what the vm_serve_gate / tests assert on)
+                    rec.event("vm_swap", outcome="fallback",
+                              champion=champ_path, detail=str(e))
+                    print(f"champion not VM-lowerable ({e}); falling "
+                          "back to the AOT closure engine",
+                          file=sys.stderr)
+            if engine is None:
+                engine = ServeEngine(champion, wl, **build_kw)
         if rec.enabled:
             rec.annotate_meta(
                 engine=engine.engine_name,
+                engine_kind=engine.engine_kind,
                 champion={"score": engine.champion.score,
                           "source": engine.champion.source},
                 envelope=engine.envelope.to_json(),
                 policy_tier=engine.policy_tier,
                 prefilter_k=engine.prefilter_k)
+        cap = getattr(engine, "program_capacity", None)
         print(f"serving champion score={engine.champion.score:.4f} "
               f"tier={engine.policy_tier} engine={engine.engine_name} "
-              f"prefilter_k={engine.prefilter_k}", file=sys.stderr)
+              f"kind={engine.engine_kind}"
+              + (f" capacity={cap}" if cap else "")
+              + f" prefilter_k={engine.prefilter_k}", file=sys.stderr)
         if args.save_artifact:
             if args.warmup:
                 engine.warmup()
@@ -663,6 +683,11 @@ def cmd_serve(args):
             result = selftest(engine, count=args.selftest,
                               pods_per_query=args.pods_per_query,
                               tol=args.audit_tol)
+            if getattr(args, "serve_engine", "aot") == "vm":
+                # did the requested VM binding actually engage, or did
+                # the champion fall back to the AOT closure engine?
+                result["vm_coverage"] = (1.0 if engine.engine_kind == "vm"
+                                         else 0.0)
             if rec.enabled and "snapshot_cache" in result:
                 rec.metric("snapshot_cache", **result["snapshot_cache"])
             print(json.dumps(result, indent=2))
@@ -1386,6 +1411,14 @@ def main(argv=None) -> int:
     sv.add_argument("--artifact", default="",
                     help="load a saved serve artifact directory instead of "
                          "building from --champion/--trace")
+    sv.add_argument("--serve-engine", choices=("aot", "vm"), default="aot",
+                    help="champion binding: 'aot' bakes the policy into "
+                         "per-champion closure executables (the exact "
+                         "reference); 'vm' serves the champion as data — "
+                         "register-program tables passed to champion-"
+                         "agnostic executables, so a promotion hot-swap "
+                         "is a table upload with zero XLA compiles "
+                         "(VM-unlowerable champions fall back to aot)")
     sv.add_argument("--save-artifact", default="",
                     help="persist the built engine (artifact.json + XLA "
                          "compilation cache) to this directory")

@@ -490,6 +490,7 @@ class CodeEvaluator:
         completion order.
         """
         seg0 = self.segments_dispatched
+        vm0 = self.vm_count
         pf_rejected = 0
         fp_dupes = 0
         works: List[int] = []  # static per-node work bounds (accepted)
@@ -660,6 +661,11 @@ class CodeEvaluator:
             "segments": self.segments_dispatched - seg0,
             "budget_pruned": sum(r["entered"] - r["survived"]
                                  for r in self.last_budget_stats),
+            # fraction of the batch's unique candidates served by the
+            # VM tier — the live estimate of how much of the population
+            # the zero-rebuild serve fast path can carry
+            "vm_coverage": round((self.vm_count - vm0)
+                                 / max(1, len(unique)), 4),
         }
 
         out = []

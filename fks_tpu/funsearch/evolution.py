@@ -196,6 +196,11 @@ class GenerationStats:
     # on unbudgeted runs — the pre-budget schema unchanged)
     budget_pruned: int = 0
     budget_device_seconds: float = 0.0
+    # fraction of this generation's unique candidates that lowered to
+    # the VM register tier (backend.last_eval_stats) — the population's
+    # eligibility for the zero-rebuild VM serve fast path (0.0 on
+    # evaluators without the stat — the pre-VM-serve schema unchanged)
+    vm_coverage: float = 0.0
 
 
 def _percentile(sorted_desc: Sequence[float], q: float) -> float:
@@ -736,7 +741,9 @@ class FunSearch:
             budget_pruned=sum(r["entered"] - r["survived"]
                               for r in budget_rungs),
             budget_device_seconds=round(sum(r["device_seconds"]
-                                            for r in budget_rungs), 6))
+                                            for r in budget_rungs), 6),
+            vm_coverage=float(getattr(self.evaluator, "last_eval_stats",
+                                      {}).get("vm_coverage", 0.0)))
         self.history.append(stats)
         # ledger first: the flight-recorder trail must be complete even if a
         # user on_generation callback raises

@@ -675,6 +675,15 @@ def score_static(prog: VMProgram, pod: PodView, nodes: NodeView) -> jax.Array:
     return _execute(prog, pod, nodes, prog.capacity)
 
 
+def capacity_bucket(n_ops: int) -> int:
+    """Program-capacity bucket for ``n_ops`` live ops: the smallest power
+    of two covering it, floored at 64 (``compile_policy``'s own default
+    ladder). The serve tier keys its compiled programs on this bucket —
+    every champion padding to the same rung shares ONE executable, so a
+    hot-swap is a table upload, never a recompile."""
+    return max(64, 1 << max(0, int(n_ops) - 1).bit_length())
+
+
 def pad_capacity(prog: VMProgram, capacity: int) -> VMProgram:
     """Re-pad a program's op arrays to ``capacity`` (NOP fill)."""
     n_live = int(prog.n_ops)

@@ -78,6 +78,15 @@ DEFAULT_THRESHOLDS: Dict[str, Threshold] = {
     # 2-point absolute jump in overhead means the null/hot path grew a
     # real cost (the value is already a percentage, so abs only)
     "trace_overhead_pct": Threshold(higher_is_better=False, abs_tol=2.0),
+    # VM-native promotion (bench stage_promote): the zero-rebuild swap
+    # must stay a swap — transpile + pack + H2D only. Latency gets the
+    # serve_p99_ms treatment (25% rel with a 2 ms CPU-jitter floor);
+    # the swap's device traffic must not regress at all beyond a
+    # 64-byte padding-jitter floor — growth means program packing broke
+    "promotion_swap_ms": Threshold(higher_is_better=False, rel=0.25,
+                                   abs_tol=2.0),
+    "vm_swap_h2d_bytes": Threshold(higher_is_better=False,
+                                   rel=0.0, abs_tol=64.0),
     # static pre-flight (bench stage_preflight): the fraction of the
     # candidate stream rejected before sandbox/transpile must not drop
     # more than 5 points — a drop means the analyzer stopped catching a
@@ -124,7 +133,8 @@ def _from_run_dir(run_dir: str) -> Dict[str, float]:
         # latency/upload volume/trace cost: best (lowest) observation,
         # mirroring serve_qps's max
         for key in ("serve_p99_ms", "serve_h2d_bytes_per_query",
-                    "trace_overhead_pct"):
+                    "trace_overhead_pct", "promotion_swap_ms",
+                    "vm_swap_h2d_bytes"):
             v = _num(m.get(key))
             if v is not None:
                 out[key] = min(out.get(key, v), v)
@@ -165,12 +175,14 @@ def _from_jsonl(path: str, allow_stale: bool = False) -> Dict[str, float]:
                     "budget_champion_match", "scale1k_events_per_sec",
                     "serve_p99_ms", "serve_qps", "serve_sharded_qps",
                     "serve_h2d_bytes_per_query", "preflight_reject_rate",
-                    "trace_overhead_pct"):
+                    "trace_overhead_pct", "promotion_swap_ms",
+                    "vm_swap_h2d_bytes"):
             v = _num(rec.get(key))
             if v is None:
                 continue
             if key in ("compile_seconds", "serve_p99_ms",
-                       "serve_h2d_bytes_per_query", "trace_overhead_pct"):
+                       "serve_h2d_bytes_per_query", "trace_overhead_pct",
+                       "promotion_swap_ms", "vm_swap_h2d_bytes"):
                 out[key] = min(out.get(key, v), v)
             else:
                 out[key] = max(out.get(key, v), v)

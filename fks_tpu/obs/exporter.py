@@ -58,6 +58,8 @@ GENERATION_GAUGES = (
      "candidates pruned by the eval-budget probe rung"),
     ("generation_budget_device_seconds", "budget_device_seconds",
      "device wall seconds across all budget rungs"),
+    ("generation_vm_coverage", "vm_coverage",
+     "fraction of unique candidates lowerable to the VM tier"),
 )
 
 

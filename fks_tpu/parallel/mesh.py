@@ -254,6 +254,21 @@ def make_sharded_serve_fn(serve_fn, mesh: Mesh):
                      out_specs=P(axes), check_vma=False)
 
 
+def make_sharded_vm_serve_fn(serve_fn, mesh: Mesh):
+    """``make_sharded_serve_fn`` for the VM-native serving pipeline
+    ``(program, pods, ktable, state0) -> SimResult``: the batch axes
+    shard exactly as before, while the champion's packed ``VMProgram``
+    tables (argument 0) are REPLICATED — ``P()`` as a pytree-prefix spec
+    — so every device holds the full register program and lanes stay
+    collective-free. One executable per (global_lanes, pod_bucket,
+    program_capacity) then serves EVERY champion of that capacity bucket
+    across the whole mesh."""
+    axes = _pop_axes(mesh)
+    return shard_map(serve_fn, mesh=mesh,
+                     in_specs=(P(), P(axes), P(axes), P(axes)),
+                     out_specs=P(axes), check_vma=False)
+
+
 def _global_results(run, state0, params_shard, axes):
     """Per-shard batched SimResult + the all-gather of the full population
     fitness vector (shared preamble of eval and generation-step). On a 1-D

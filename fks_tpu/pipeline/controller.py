@@ -45,6 +45,7 @@ import numpy as np
 from fks_tpu import obs
 from fks_tpu.obs import trace_ctx
 from fks_tpu.obs.history import SLOConfig, slo_burn
+from fks_tpu.funsearch.vm import VMUnsupported
 from fks_tpu.pipeline.faults import FaultPlan, KillSwitch, NO_FAULTS
 from fks_tpu.pipeline.state import PromotionLog, TERMINAL
 from fks_tpu.serve.artifact import (
@@ -184,16 +185,20 @@ class PromotionController:
         try:
             self.faults.maybe_eval_error()
             with obs.span("build", attempt=aid):
-                shadow = self._factory(champ)
+                shadow, engine_kind = self._build_shadow(champ, incumbent,
+                                                         aid, path)
         except KillSwitch:
             raise
         except Exception as e:  # device eval / transpile / OOM — degrade
             return self._reject(aid, path,
                                 f"build_failed: {type(e).__name__}: {e}")
-        self._transition(aid, "SHADOW", champion=path)
+        self._transition(aid, "SHADOW", champion=path,
+                         engine_kind=engine_kind)
         try:
             with obs.span("shadow", attempt=aid):
-                verdict = self._shadow_eval(shadow, incumbent)
+                verdict = self._shadow_eval(
+                    shadow, incumbent,
+                    exact_reference=(engine_kind != "vm"))
         except KillSwitch:
             raise
         except Exception as e:
@@ -209,12 +214,18 @@ class PromotionController:
         # kill between the two resolves to the new champion on restart
         self._transition(aid, "PROMOTED", champion=path,
                          previous=incumbent.champion.source,
-                         shadow=_strip(verdict))
+                         engine_kind=engine_kind, shadow=_strip(verdict))
         t1 = time.perf_counter()
-        old = self.service.swap_engine(shadow)
+        # the swap: VM fast path uploads the candidate's tables INTO the
+        # resident engine (swap_engine dispatches on ChampionSpec — no
+        # rebuild was ever on this path); AOT path flips to the prebuilt
+        # shadow engine. Either way the rollback handle comes back.
+        old = self.service.swap_engine(
+            champ if engine_kind == "vm" else shadow)
         self.last_swap_ms = round((time.perf_counter() - t1) * 1e3, 3)
         trace_ctx.emit(self.recorder, "promotion/swap",
-                       self.last_swap_ms / 1e3, attempt=aid)
+                       self.last_swap_ms / 1e3, attempt=aid,
+                       engine_kind=engine_kind)
         self._done.add(aid)
         self._probation = {"attempt": aid, "champion": path,
                            "old_engine": old,
@@ -222,15 +233,46 @@ class PromotionController:
                            "t0": time.monotonic()}
         self.recorder.metric("promotion_event", attempt=aid,
                              state="SWAPPED", champion=path,
-                             swap_ms=self.last_swap_ms)
+                             swap_ms=self.last_swap_ms,
+                             engine_kind=engine_kind)
         return {"action": "promoted", "attempt": aid, "champion": path,
-                "swap_ms": self.last_swap_ms, "shadow": _strip(verdict)}
+                "swap_ms": self.last_swap_ms, "engine_kind": engine_kind,
+                "shadow": _strip(verdict)}
+
+    def _build_shadow(self, champ: ChampionSpec, incumbent, aid: str,
+                      path: str):
+        """The candidate's shadow engine plus how the swap will bind it.
+
+        VM fast path: an incumbent exposing ``shadow_for`` (the VM-native
+        engine) lowers the candidate into a shadow VIEW sharing the warm
+        champion-agnostic executables — zero XLA compiles on this
+        process. ``VMUnsupported`` (candidate outside the VM vocabulary,
+        or longer than the resident capacity bucket) records a fallback
+        ``vm_swap`` event and degrades to the AOT closure build; any
+        other failure (TranspileError, OOM) propagates to the caller's
+        build_failed reject exactly as before."""
+        if hasattr(incumbent, "shadow_for"):
+            try:
+                return incumbent.shadow_for(champ), "vm"
+            except VMUnsupported as e:
+                self.recorder.event(
+                    "vm_swap", outcome="fallback", champion=path,
+                    attempt=aid, detail=f"{type(e).__name__}: {e}")
+        return self._factory(champ), "aot"
 
     # ----------------------------------------------------- shadow eval
 
-    def _shadow_eval(self, shadow, incumbent) -> Dict[str, Any]:
+    def _shadow_eval(self, shadow, incumbent,
+                     exact_reference: bool = True) -> Dict[str, Any]:
         """Replay recent live traffic through the candidate, gate on
-        parity / p99-vs-incumbent / SLO burn / robust suite."""
+        parity / p99-vs-incumbent / SLO burn / robust suite.
+
+        ``exact_reference=False`` (the VM fast path) skips the per-query
+        unbatched exact reference: re-jitting it for the new champion
+        would compile on the serving process, defeating the zero-compile
+        swap. VM-vs-AOT score parity is instead guaranteed offline
+        (tests/test_vm_serve.py and the run_full_suite vm_serve_gate);
+        the replay still gates latency, SLO burn and the robust suite."""
         cfg = self.cfg
         queries = self.service.recent_queries(cfg.shadow_queries)
         if not queries:
@@ -244,11 +286,12 @@ class PromotionController:
             t0 = time.perf_counter()
             ans = shadow.answer_batch([q])[0]
             lat.append((time.perf_counter() - t0 + delay) * 1e3)
-            ref = shadow.reference_answer(q)
-            sentinel.audit_served(
-                f"shadow-{i}", ans["score"], ref["score"],
-                placements_match=ans["placements"] == ref["placements"],
-                source="shadow")
+            if exact_reference:
+                ref = shadow.reference_answer(q)
+                sentinel.audit_served(
+                    f"shadow-{i}", ans["score"], ref["score"],
+                    placements_match=ans["placements"] == ref["placements"],
+                    source="shadow")
             t0 = time.perf_counter()
             incumbent.answer_batch([q])
             inc_lat.append((time.perf_counter() - t0) * 1e3)
@@ -279,6 +322,8 @@ class PromotionController:
         return {"failures": failures, "queries": len(queries),
                 "p99_ms": round(p99, 3), "incumbent_p99_ms": round(inc_p99, 3),
                 "parity_alerts": sentinel.alerts,
+                "parity_mode": ("exact_reference" if exact_reference
+                                else "offline"),
                 "robust": robust, "incumbent_robust": inc_robust}
 
     def _robust_scores(self, shadow, incumbent):

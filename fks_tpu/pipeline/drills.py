@@ -111,7 +111,8 @@ def run_drills(log: Callable[[str], None] = print,
                   _drill_p99_regression_rejected, _drill_kill_pending,
                   _drill_kill_shadow, _drill_kill_promoted,
                   _drill_rollback_on_burn, _drill_zero_recompile_swap,
-                  _drill_llm_outage, *RESILIENCE_DRILLS):
+                  _drill_vm_double_swap, _drill_llm_outage,
+                  *RESILIENCE_DRILLS):
         name = drill.__name__.replace("_drill_", "")
         if filters and not any(f in name for f in filters):
             continue
@@ -290,6 +291,55 @@ def _drill_zero_recompile_swap(stack: DrillStack) -> Dict[str, Any]:
                            and recompiles == 0 and len(answers) == 4),
                     "action": out["action"], "recompiles": recompiles,
                     "swap_ms": ctrl.last_swap_ms}
+    finally:
+        service.close()
+
+
+def _drill_vm_double_swap(stack: DrillStack) -> Dict[str, Any]:
+    """The VM-native promotion fast path: TWO consecutive hot-swaps on
+    a champion-as-data incumbent perform ZERO XLA compiles end to end —
+    shadow eval, swap, and post-swap traffic are all table uploads into
+    the warm executables (the ISSUE-16 vm_serve_gate contract)."""
+    from fks_tpu.funsearch import template
+    from fks_tpu.obs import CompileWatcher
+    from fks_tpu.serve import ChampionSpec, ServeService, VMServeEngine
+
+    incumbent = VMServeEngine(
+        ChampionSpec(code=stack.incumbent_code, score=0.4,
+                     source="<drill-seed>"),
+        stack.workload, envelope=stack.envelope)
+    incumbent.warmup()
+    service = ServeService(incumbent, max_wait_s=0.002)
+    try:
+        with tempfile.TemporaryDirectory(prefix="fks_drill_") as tmp:
+            stack.traffic(service, 3)
+            ctrl = stack.controller(service, tmp)
+            second = template.fill_template(
+                "score = 2000 + (node.memory_mib_left - pod.memory_mib)"
+                " / max(1, node.memory_mib_total)")
+            watcher = CompileWatcher().install()
+            try:
+                write_champion(tmp, stack.candidate_code, 0.9)
+                first = ctrl.poll_once()
+                stack.traffic(service, 2)
+                write_champion(tmp, second, 1.3)
+                then = ctrl.poll_once()
+                stack.traffic(service, 2)
+                recompiles = watcher.backend_compile_count
+            finally:
+                watcher.uninstall()
+            return {"ok": (first["action"] == "promoted"
+                           and first.get("engine_kind") == "vm"
+                           and then["action"] == "promoted"
+                           and then.get("engine_kind") == "vm"
+                           and service.engine is incumbent
+                           and incumbent.vm_swaps == 2
+                           and recompiles == 0),
+                    "first": first["action"], "then": then["action"],
+                    "recompiles": recompiles,
+                    "vm_swaps": incumbent.vm_swaps,
+                    "swap_ms": incumbent.last_swap_breakdown.get(
+                        "swap_ms", 0.0)}
     finally:
         service.close()
 

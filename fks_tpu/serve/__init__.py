@@ -2,8 +2,10 @@
 
 - artifact: champion loading, shape envelope, AOT ServeEngine (optionally
   mesh-sharded with device-resident snapshot tables), save/load.
+- vm_engine: the VM-native VMServeEngine — champion-as-data executables
+  shared across champions, zero-rebuild ``swap_program`` hot-swap.
 - batcher: query->workload construction, lane stacking, packed-upload
-  helpers, request coalescer.
+  helpers (query AND program tables), request coalescer.
 - service: request/metrics layer, JSONL + localhost HTTP fronts, selftest.
 """
 from fks_tpu.serve.artifact import (
@@ -12,18 +14,20 @@ from fks_tpu.serve.artifact import (
 )
 from fks_tpu.serve.batcher import (
     DEFAULT_DURATION, POD_FIELDS, RequestBatcher, build_query_workload,
-    pack_query_tables, pods_to_dicts, query_pack_plan, stack_queries,
-    stack_query_tables, tree_h2d_bytes, unpack_query_tables,
-    validate_query_pods,
+    pack_program_tables, pack_query_tables, pods_to_dicts, query_pack_plan,
+    stack_queries, stack_query_tables, tree_h2d_bytes,
+    unpack_program_tables, unpack_query_tables, validate_query_pods,
 )
 from fks_tpu.serve.service import ServeService, selftest
+from fks_tpu.serve.vm_engine import VMServeEngine
 
 __all__ = [
-    "ChampionSpec", "ServeEngine", "ShapeEnvelope",
+    "ChampionSpec", "ServeEngine", "ShapeEnvelope", "VMServeEngine",
     "enable_persistent_cache", "latest_champion", "load_champion",
     "DEFAULT_DURATION", "POD_FIELDS", "RequestBatcher",
-    "build_query_workload", "pack_query_tables", "pods_to_dicts",
-    "query_pack_plan", "stack_queries", "stack_query_tables",
-    "tree_h2d_bytes", "unpack_query_tables", "validate_query_pods",
+    "build_query_workload", "pack_program_tables", "pack_query_tables",
+    "pods_to_dicts", "query_pack_plan", "stack_queries",
+    "stack_query_tables", "tree_h2d_bytes", "unpack_program_tables",
+    "unpack_query_tables", "validate_query_pods",
     "ServeService", "selftest",
 ]

@@ -328,6 +328,12 @@ class ServeEngine:
     the auto-enable heuristic (``sim.engine.resolve_auto_prefilter``).
     """
 
+    #: how this engine binds the champion: "aot" bakes the policy into
+    #: the executable as a closure constant (a new champion = a rebuild);
+    #: "vm" (serve.vm_engine.VMServeEngine) passes it as a device-resident
+    #: argument (a new champion = a table upload)
+    engine_kind = "aot"
+
     def __init__(self, champion: ChampionSpec, workload: Workload, *,
                  envelope: Optional[ShapeEnvelope] = None,
                  engine: str = "exact",
@@ -648,9 +654,15 @@ class ServeEngine:
             self.h2d_bytes_total += tree_h2d_bytes(pods, s0)
             hh.sync(jax.tree_util.tree_leaves(s0)[0])
         self.h2d_queries += len(idxs)
-        res = compiled(pods, kt_dev, s0)  # async dispatch; buffers donated
+        # async dispatch; per-batch buffers donated. _invoke is the
+        # engine-kind seam: the AOT engine calls the executable directly,
+        # the VM engine prepends its device-resident champion tables.
+        res = self._invoke(compiled, pods, kt_dev, s0)
         self.last_batch_timing["pack_h2d_s"] += time.perf_counter() - t0
         return _Inflight(res, list(idxs), bucket, lanes, real)
+
+    def _invoke(self, compiled, pods, kt_dev, s0):
+        return compiled(pods, kt_dev, s0)
 
     def _harvest(self, inflight: "_Inflight", pod_lists, answers) -> None:
         """Block on a dispatched chunk and scatter its answers back."""
@@ -727,6 +739,7 @@ class ServeEngine:
             "champion": self.champion.to_json(),
             "envelope": self.envelope.to_json(),
             "engine": self.engine_name,
+            "engine_kind": self.engine_kind,
             "prefilter_k": self.prefilter_k,
             "state_pack": self.state_pack,
             "max_steps_factor": self.max_steps_factor,
@@ -734,6 +747,9 @@ class ServeEngine:
             "cluster": _cluster_to_json(self.cluster),
             "base_pods": self.base_pods,
         }
+        cap = getattr(self, "program_capacity", None)
+        if cap is not None:
+            doc["program_capacity"] = int(cap)
         path = os.path.join(directory, "artifact.json")
         tmp = path + ".tmp"
         with open(tmp, "w") as f:
@@ -759,13 +775,21 @@ class ServeEngine:
         cluster = _cluster_from_json(doc["cluster"])
         wl = Workload(cluster=cluster,
                       pods=_pods_from_dicts(doc.get("base_pods", [])))
+        extra = {}
+        if doc.get("engine_kind", "aot") == "vm" and cls.engine_kind != "vm":
+            # artifact saved by a VMServeEngine: reload it as one (the
+            # champion-as-data executable set, not the AOT ladder)
+            from fks_tpu.serve.vm_engine import VMServeEngine
+            cls = VMServeEngine
+        if cls.engine_kind == "vm" and doc.get("program_capacity"):
+            extra["program_capacity"] = int(doc["program_capacity"])
         eng = cls(ChampionSpec.from_json(doc["champion"]), wl,
                   envelope=ShapeEnvelope.from_json(doc["envelope"]),
                   engine=doc["engine"],
                   prefilter_k=int(doc["prefilter_k"]),
                   state_pack=bool(doc["state_pack"]),
                   max_steps_factor=int(doc["max_steps_factor"]),
-                  mesh=mesh, recorder=recorder)
+                  mesh=mesh, recorder=recorder, **extra)
         enable_persistent_cache(os.path.join(directory, "xla_cache"))
         return eng
 

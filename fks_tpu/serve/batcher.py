@@ -253,6 +253,33 @@ def unpack_query_tables(pods, kt, plan: dict):
     return pods, kt
 
 
+def pack_program_tables(prog) -> tuple:
+    """A ``VMProgram`` -> the packed host-side wire pytree the VM serve
+    engine uploads on a hot-swap: the four i32[O] op-index tables ride
+    ONE contiguous ``i32[4, O]`` buffer and the two i32 scalars one
+    ``i32[2]`` buffer, so a champion swap ships 4 H2D transfers
+    (tables/imm/consts/meta) instead of 8 — the ``query_pack_plan`` idea
+    applied to the program side of the upload. Host numpy throughout, so
+    the engine can size and account the transfer before it happens."""
+    tables = np.stack([np.asarray(prog.opcode), np.asarray(prog.a),
+                       np.asarray(prog.b), np.asarray(prog.c)]
+                      ).astype(np.int32)
+    meta = np.asarray([int(prog.n_ops), int(prog.out_reg)], np.int32)
+    return (tables, np.asarray(prog.imm), np.asarray(prog.consts), meta)
+
+
+def unpack_program_tables(packed):
+    """Invert ``pack_program_tables`` ON DEVICE (traced inside the
+    compiled VM serve program): split the contiguous table block back
+    into the ``VMProgram`` pytree the VM executor consumes."""
+    from fks_tpu.funsearch.vm import VMProgram
+
+    tables, imm, consts, meta = packed
+    return VMProgram(opcode=tables[0], a=tables[1], b=tables[2],
+                     c=tables[3], imm=imm, consts=consts,
+                     n_ops=meta[0], out_reg=meta[1])
+
+
 def tree_h2d_bytes(*trees) -> int:
     """Total bytes a host->device upload of these pytrees ships — the
     engine's ``serve_h2d_bytes_per_query`` accounting."""

@@ -29,7 +29,7 @@ from fks_tpu.obs.history import SLOConfig, record_slo_burn
 from fks_tpu.obs.watchdog import ParitySentinel
 from fks_tpu.resilience.deadline import Deadline, ResilienceError
 from fks_tpu.resilience.degrade import DegradeConfig, DegradedModeManager
-from fks_tpu.serve.artifact import ServeEngine
+from fks_tpu.serve.artifact import ChampionSpec, ServeEngine
 from fks_tpu.serve.batcher import RequestBatcher, pods_to_dicts
 
 
@@ -84,16 +84,34 @@ class ServeService:
 
     # ----- engine hot-swap + replay (fks_tpu.pipeline)
 
-    def swap_engine(self, new_engine: ServeEngine) -> ServeEngine:
-        """Atomically flip the serving engine; returns the old one (the
-        rollback handle). A single attribute assignment is the entire
-        swap — ``_handle_batch`` reads ``self.engine`` once per batch, so
-        an in-flight batch finishes on the old engine and the next batch
-        lands on the new one; nothing is ever half-swapped. Safe only if
-        ``new_engine`` is already warm (the promotion controller builds
-        and warms the bucket ladder off the request path)."""
-        old = self.engine
-        self.engine = new_engine
+    def swap_engine(self, new_engine):
+        """Flip what the service serves; returns the rollback handle.
+
+        Two shapes, one seam:
+
+        - a warm ``ServeEngine`` (the AOT closure path): a single
+          attribute assignment is the entire swap — ``_handle_batch``
+          reads ``self.engine`` once per batch, so an in-flight batch
+          finishes on the old engine and the next lands on the new one;
+          returns the old ENGINE. Safe only if ``new_engine`` is already
+          warm (the promotion controller builds and warms the bucket
+          ladder off the request path).
+        - a ``ChampionSpec`` (the VM-native path): the resident engine
+          re-binds its champion tables IN PLACE via ``swap_program`` —
+          a packed H2D upload, no rebuild, no new object; returns the
+          old ``ChampionSpec``, so a probation rollback passing it back
+          here symmetrically re-uploads the old tables."""
+        if isinstance(new_engine, ChampionSpec):
+            swap = getattr(self.engine, "swap_program", None)
+            if swap is None:
+                raise TypeError(
+                    "swap_engine(ChampionSpec) requires a VM-native engine "
+                    "with swap_program; this service runs "
+                    f"engine_kind={getattr(self.engine, 'engine_kind', '?')}")
+            old = swap(new_engine)
+        else:
+            old = self.engine
+            self.engine = new_engine
         self.swaps += 1
         return old
 
@@ -348,6 +366,8 @@ class ServeService:
             else 0.0,
             "qps": round(len(lat) / elapsed, 2) if elapsed > 0 else 0.0,
             "cold_compiles": self.engine.cold_compiles,
+            "engine_kind": getattr(self.engine, "engine_kind", "aot"),
+            "policy_tier": getattr(self.engine, "policy_tier", ""),
             "audits": self.audits,
             "audit_failures": self.audit_failures,
             "swaps": self.swaps,
@@ -359,6 +379,14 @@ class ServeService:
             "engine_state": (self._degrade.state
                              if self._degrade is not None else "normal"),
         }
+        # VM-native engine extras: the capacity bucket its executables
+        # are keyed on and the zero-rebuild swap accounting
+        cap = getattr(self.engine, "program_capacity", None)
+        if cap:
+            out["program_capacity"] = int(cap)
+            out["vm_swaps"] = int(getattr(self.engine, "vm_swaps", 0))
+            out["vm_swap_h2d_bytes"] = int(
+                getattr(self.engine, "vm_swap_h2d_bytes", 0))
         # device-resident snapshot cache + H2D accounting (engines
         # predating the cache — or test doubles — simply omit the block)
         cache_stats = getattr(self.engine, "snapshot_cache_stats", None)
@@ -551,8 +579,13 @@ def selftest(engine: ServeEngine, count: int = 8, pods_per_query: int = 4,
         "placements_match": placements_ok,
         "tol": tol,
         "engine": engine.engine_name,
+        "engine_kind": getattr(engine, "engine_kind", "aot"),
+        "policy_tier": getattr(engine, "policy_tier", ""),
         "failures": failures[:5],
     }
+    cap = getattr(engine, "program_capacity", None)
+    if cap:
+        out["program_capacity"] = int(cap)
     cache_stats = getattr(engine, "snapshot_cache_stats", None)
     if callable(cache_stats):
         out["snapshot_cache"] = cache_stats()

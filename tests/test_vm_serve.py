@@ -1,0 +1,390 @@
+"""VM-native serving tests (fks_tpu.serve.vm_engine + the controller's
+zero-rebuild promotion fast path).
+
+The ISSUE-16 acceptance criteria, as tests:
+
+- VM-vs-AOT parity: the champion-as-data engine answers every query
+  with the same score/placements as the AOT closure engine — exact on
+  the integer contract, <= 1e-5 otherwise;
+- zero-rebuild hot swap: TWO consecutive promotions through the live
+  PromotionController perform ZERO XLA compiles on the serving process
+  (CompileWatcher delta == 0) — the swap is transpile + pack + H2D;
+- AOT fallback: a VM-unlowerable candidate promotes through the
+  closure-engine slow path with a recorded ``vm_swap`` fallback event;
+- per-lane isolation on the 8-virtual-device mesh: a lane's answer is
+  independent of its batch neighbours, and matches the plain engine.
+
+Plus units for the capacity bucket, the packed program wire format,
+artifact round-trip (engine_kind dispatch), the service summary
+surface, and the evolution ledger's ``vm_coverage`` stat.
+"""
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from fks_tpu.data.synthetic import synthetic_workload
+from fks_tpu.funsearch import backend, template, vm
+from fks_tpu.obs import CompileWatcher
+from fks_tpu.parallel.mesh import num_shards, population_mesh
+from fks_tpu.pipeline import (
+    PromotionConfig, PromotionController, write_champion,
+)
+from fks_tpu.serve import (
+    ChampionSpec, ServeEngine, ServeService, ShapeEnvelope, VMServeEngine,
+    pack_program_tables, unpack_program_tables,
+)
+
+SEED_LOGIC = "score = 1000"
+BETTER_LOGIC = ("score = 1000 + (node.cpu_milli_left - pod.cpu_milli) "
+                "/ max(1, node.cpu_milli_total)")
+EVEN_BETTER_LOGIC = ("score = 2000 + (node.memory_mib_left - "
+                     "pod.memory_mib) / max(1, node.memory_mib_total)")
+UNSUPPORTED_LOGIC = ("gpus = sorted(g.gpu_milli_left for g in node.gpus)\n"
+                     "return max(1, gpus[0]) if pod.num_gpu == 0 else 1")
+
+
+def _champ(logic, score=0.5, source="<test>"):
+    return ChampionSpec(code=template.fill_template(logic), score=score,
+                        source=source)
+
+
+def _query(i, n=3):
+    return [{"cpu_milli": 10 + 7 * i + j, "memory_mib": 50 + 11 * j,
+             "creation_time": j, "duration_time": 40}
+            for j in range(n)]
+
+
+def _traffic(service, n=3, pods=3):
+    base = service.engine.base_pods
+    futs = [service.submit(
+        {"pods": [dict(base[(i + j) % len(base)]) for j in range(pods)]})
+        for i in range(n)]
+    return [f.result(timeout=300) for f in futs]
+
+
+class RecStub:
+    """Recorder double: keeps every event/metric for assertions. The
+    ``metric`` signature must absorb positional record payloads."""
+
+    enabled = True
+
+    def __init__(self):
+        self.events = []
+        self.metrics = []
+
+    def event(self, kind, **fields):
+        self.events.append({"kind": kind, **fields})
+
+    def metric(self, kind, *a, **fields):
+        self.metrics.append({"kind": kind, **fields})
+
+
+@pytest.fixture(scope="module")
+def wl():
+    return synthetic_workload(8, 16, seed=0)
+
+
+@pytest.fixture(scope="module")
+def envelope():
+    return ShapeEnvelope(max_pods=8, min_pod_bucket=8, max_batch=2,
+                         max_gpu_milli=1000)
+
+
+@pytest.fixture(scope="module")
+def aot(wl, envelope):
+    return ServeEngine(_champ(BETTER_LOGIC), wl, envelope=envelope,
+                       engine="flat")
+
+
+@pytest.fixture(scope="module")
+def vm_engine(wl, envelope):
+    return VMServeEngine(_champ(BETTER_LOGIC), wl, envelope=envelope,
+                        engine="flat")
+
+
+# ------------------------------------------------------------- units
+
+
+def test_capacity_bucket():
+    assert vm.capacity_bucket(0) == 64
+    assert vm.capacity_bucket(1) == 64
+    assert vm.capacity_bucket(64) == 64
+    assert vm.capacity_bucket(65) == 128
+    assert vm.capacity_bucket(128) == 128
+    assert vm.capacity_bucket(200) == 256
+
+
+def test_pack_program_tables_round_trip():
+    prog = vm.compile_policy(template.fill_template(BETTER_LOGIC), 8, 2)
+    packed = pack_program_tables(prog)
+    tables = packed[0]
+    assert tables.shape == (4, prog.capacity)  # ONE op-table buffer
+    assert tables.dtype == np.int32
+    back = unpack_program_tables(packed)
+    for a, b in zip(prog, back):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_vm_engine_binds_champion_as_data(vm_engine):
+    assert vm_engine.engine_kind == "vm"
+    assert vm_engine.policy_tier == "vm"
+    assert vm_engine.program_capacity >= int(vm_engine.params.n_ops)
+    # capacity is a pow2 bucket floored at 64 — shared across champions
+    cap = vm_engine.program_capacity
+    assert cap >= 64 and cap & (cap - 1) == 0
+
+
+def test_vm_unsupported_champion_raises_at_construction(wl, envelope):
+    with pytest.raises(vm.VMUnsupported):
+        VMServeEngine(_champ(UNSUPPORTED_LOGIC), wl, envelope=envelope,
+                      engine="flat")
+
+
+# ------------------------------------------------------------- parity
+
+
+def test_vm_matches_aot_on_batches(aot, vm_engine):
+    queries = [_query(i) for i in range(4)]
+    a = aot.answer_batch(queries)
+    b = vm_engine.answer_batch(queries)
+    for i, (pa, pb) in enumerate(zip(a, b)):
+        # float arithmetic champion: x64 tests evaluate both tiers in
+        # f64, so the contract is <= 1e-5 (observed: exact)
+        assert abs(pa["score"] - pb["score"]) <= 1e-5, f"lane {i} score"
+        assert pa["placements"] == pb["placements"], f"lane {i} placements"
+
+
+def test_vm_matches_aot_exactly_on_integer_contract(wl, envelope):
+    logic = "score = 3 * node.cpu_milli_left - 2 * pod.cpu_milli"
+    a = ServeEngine(_champ(logic), wl, envelope=envelope, engine="flat")
+    b = VMServeEngine(_champ(logic), wl, envelope=envelope, engine="flat")
+    queries = [_query(10 + i) for i in range(3)]
+    for pa, pb in zip(a.answer_batch(queries), b.answer_batch(queries)):
+        assert pa["score"] == pb["score"]  # integer contract: exact
+        assert pa["placements"] == pb["placements"]
+
+
+# ------------------------------------------------ zero-rebuild hot swap
+
+
+def test_double_hot_swap_zero_recompiles(wl, envelope, tmp_path):
+    """TWO consecutive promotions through the live controller: every
+    swap is a table upload into the warm executables — zero XLA
+    compiles across shadow eval, swap, and post-swap traffic."""
+    rec = RecStub()
+    incumbent = VMServeEngine(_champ(SEED_LOGIC, 0.4), wl,
+                              envelope=envelope, engine="flat",
+                              recorder=rec)
+    incumbent.warmup()
+    service = ServeService(incumbent, max_wait_s=0.002)
+    try:
+        _traffic(service, 4)  # replay buffer for the shadow eval
+        ctrl = PromotionController(
+            service, wl, ledger_dir=str(tmp_path),
+            log_path=os.path.join(str(tmp_path), "promotion.jsonl"),
+            config=PromotionConfig(shadow_queries=2), recorder=rec)
+        watcher = CompileWatcher().install()
+        try:
+            write_champion(str(tmp_path),
+                           template.fill_template(BETTER_LOGIC), 0.9)
+            v1 = ctrl.poll_once()
+            _traffic(service, 3)
+            write_champion(str(tmp_path),
+                           template.fill_template(EVEN_BETTER_LOGIC), 1.3)
+            v2 = ctrl.poll_once()
+            _traffic(service, 3)
+            compiles = watcher.backend_compile_count
+        finally:
+            watcher.uninstall()
+        assert v1.get("action") == "promoted" and \
+            v1.get("engine_kind") == "vm", v1
+        assert v2.get("action") == "promoted" and \
+            v2.get("engine_kind") == "vm", v2
+        assert compiles == 0, (
+            f"{compiles} XLA programs compiled across two VM hot-swaps "
+            "— promotion must be transpile + pack + H2D only")
+        # the swap was IN PLACE: same engine object, new champion tables
+        assert service.engine is incumbent
+        assert incumbent.vm_swaps == 2
+        assert incumbent.vm_swap_h2d_bytes > 0
+        bd = incumbent.last_swap_breakdown
+        assert bd["h2d_bytes"] > 0 and bd["swap_ms"] >= 0.0
+        assert bd["capacity"] == incumbent.program_capacity
+        swaps = [e for e in rec.events if e["kind"] == "vm_swap"]
+        assert [e["outcome"] for e in swaps] == ["swapped", "swapped"]
+    finally:
+        service.close()
+
+
+def test_swap_program_returns_rollback_handle(wl, envelope):
+    eng = VMServeEngine(_champ(SEED_LOGIC, 0.4, source="<old>"), wl,
+                        envelope=envelope, engine="flat")
+    queries = [_query(40)]
+    before = eng.answer_batch(queries)
+    old = eng.swap_program(_champ(BETTER_LOGIC, 0.9, source="<new>"))
+    assert old.source == "<old>"
+    assert eng.champion.source == "<new>"
+    # the swapped-in tables serve EXACTLY like an engine built on the
+    # new champion from scratch
+    fresh = VMServeEngine(_champ(BETTER_LOGIC, 0.9), wl,
+                          envelope=envelope, engine="flat")
+    swapped = eng.answer_batch(queries)
+    target = fresh.answer_batch(queries)
+    assert swapped[0]["score"] == target[0]["score"]
+    assert swapped[0]["placements"] == target[0]["placements"]
+    eng.swap_program(old)  # rolling back is another swap_program
+    rolled = eng.answer_batch(queries)
+    assert rolled[0]["score"] == before[0]["score"]
+    assert rolled[0]["placements"] == before[0]["placements"]
+
+
+def test_service_swap_engine_routes_championspec(wl, envelope):
+    eng = VMServeEngine(_champ(SEED_LOGIC, 0.4, source="<old>"), wl,
+                        envelope=envelope, engine="flat")
+    service = ServeService(eng, max_wait_s=0.002)
+    try:
+        old = service.swap_engine(_champ(BETTER_LOGIC, 0.9))
+        assert isinstance(old, ChampionSpec) and old.source == "<old>"
+        assert service.engine is eng  # in-place: no engine flip
+        assert service.swaps == 1
+        summary = service.summary()
+        assert summary["engine_kind"] == "vm"
+        assert summary["program_capacity"] == eng.program_capacity
+        assert summary["vm_swaps"] == 1
+        assert summary["vm_swap_h2d_bytes"] > 0
+        # an AOT engine has no swap_program: ChampionSpec must be refused
+        plain = ServeEngine(_champ(SEED_LOGIC), wl, envelope=envelope,
+                            engine="flat")
+        service.swap_engine(plain)
+        with pytest.raises(TypeError):
+            service.swap_engine(_champ(BETTER_LOGIC, 0.9))
+    finally:
+        service.close()
+
+
+# --------------------------------------------------------- AOT fallback
+
+
+def test_vm_unsupported_candidate_falls_back_to_aot(wl, envelope,
+                                                    tmp_path):
+    """A candidate outside the VM vocabulary still promotes — through
+    the AOT closure factory — and the fallback is a recorded event."""
+    rec = RecStub()
+    incumbent = VMServeEngine(_champ(SEED_LOGIC, 0.4), wl,
+                              envelope=envelope, engine="flat")
+    incumbent.warmup()
+    service = ServeService(incumbent, max_wait_s=0.002)
+    try:
+        _traffic(service, 4)
+        ctrl = PromotionController(
+            service, wl, ledger_dir=str(tmp_path),
+            log_path=os.path.join(str(tmp_path), "promotion.jsonl"),
+            config=PromotionConfig(shadow_queries=2), recorder=rec)
+        write_champion(str(tmp_path),
+                       template.fill_template(UNSUPPORTED_LOGIC), 0.9)
+        verdict = ctrl.poll_once()
+        assert verdict.get("action") == "promoted", verdict
+        assert verdict.get("engine_kind") == "aot"
+        # the service flipped to a NEW closure engine — the VM incumbent
+        # could not serve this champion in place
+        assert service.engine is not incumbent
+        assert service.engine.engine_kind == "aot"
+        falls = [e for e in rec.events
+                 if e["kind"] == "vm_swap" and e["outcome"] == "fallback"]
+        assert len(falls) == 1
+        assert "sort" in falls[0]["detail"]
+        _traffic(service, 2)  # the promoted AOT engine serves
+    finally:
+        service.close()
+
+
+# ------------------------------------------------------- mesh sharding
+
+
+def test_mesh_per_lane_isolation_and_parity(wl):
+    """8-virtual-device mesh: each lane of a full batch answers exactly
+    as the plain single-device VM engine, alone or together — and the
+    program tables replicate while the lanes shard."""
+    assert num_shards(population_mesh(jax.devices())) >= 8
+    env = ShapeEnvelope(max_pods=8, min_pod_bucket=8, max_batch=8,
+                        max_gpu_milli=1000)
+    plain = VMServeEngine(_champ(BETTER_LOGIC), wl, envelope=env,
+                          engine="flat")
+    sharded = VMServeEngine(_champ(BETTER_LOGIC), wl, envelope=env,
+                            engine="flat",
+                            mesh=population_mesh(jax.devices()))
+    queries = [_query(60 + i) for i in range(8)]
+    together = sharded.answer_batch(queries)
+    baseline = plain.answer_batch(queries)
+    for i, (t, b) in enumerate(zip(together, baseline)):
+        assert t["score"] == b["score"], f"lane {i} score"
+        assert t["placements"] == b["placements"], f"lane {i} placements"
+    alone = [sharded.answer_batch([q])[0] for q in queries[:3]]
+    for t, s in zip(together, alone):
+        assert t["score"] == s["score"]
+        assert t["placements"] == s["placements"]
+
+
+def test_mesh_swap_keeps_parity(wl):
+    env = ShapeEnvelope(max_pods=8, min_pod_bucket=8, max_batch=4,
+                        max_gpu_milli=1000)
+    sharded = VMServeEngine(_champ(SEED_LOGIC), wl, envelope=env,
+                            engine="flat",
+                            mesh=population_mesh(jax.devices()))
+    sharded.swap_program(_champ(BETTER_LOGIC, 0.9))
+    fresh = VMServeEngine(_champ(BETTER_LOGIC, 0.9), wl, envelope=env,
+                          engine="flat")
+    queries = [_query(70 + i) for i in range(4)]
+    for a, b in zip(sharded.answer_batch(queries),
+                    fresh.answer_batch(queries)):
+        assert a["score"] == b["score"]
+        assert a["placements"] == b["placements"]
+
+
+# ----------------------------------------------------- artifact + ledger
+
+
+def test_vm_artifact_round_trip(tmp_path, wl, envelope):
+    eng = VMServeEngine(_champ(BETTER_LOGIC), wl, envelope=envelope,
+                        engine="flat")
+    queries = [_query(80), _query(81)]
+    before = eng.answer_batch(queries)
+    d = str(tmp_path / "artifact")
+    eng.save(d)
+    loaded = ServeEngine.load(d)  # engine_kind dispatch in load()
+    assert isinstance(loaded, VMServeEngine)
+    assert loaded.engine_kind == "vm"
+    assert loaded.program_capacity == eng.program_capacity
+    after = loaded.answer_batch(queries)
+    for a, b in zip(before, after):
+        assert a["score"] == b["score"]
+        assert a["placements"] == b["placements"]
+
+
+def test_vm_coverage_stat(micro_workload):
+    """The ledger's vm_coverage: fraction of the batch's unique
+    candidates served by the VM tier."""
+    from tests.test_vm import _corpus
+
+    ev = backend.CodeEvaluator(micro_workload, vm_batch=True)
+    vmable = _corpus()[:3]
+    hard = template.fill_template(UNSUPPORTED_LOGIC)
+    ev.evaluate(vmable + [hard])
+    assert ev.last_eval_stats["vm_coverage"] == pytest.approx(3 / 4)
+    ev.evaluate(vmable)
+    assert ev.last_eval_stats["vm_coverage"] == 1.0
+
+
+def test_generation_stats_carries_vm_coverage():
+    from fks_tpu.funsearch.evolution import GenerationStats
+
+    stats = GenerationStats(generation=1, best_score=1.0, mean_score=1.0,
+                            new_candidates=4, accepted=2,
+                            rejected_similar=0, eval_seconds=0.1,
+                            compile_count=0, vm_coverage=0.75)
+    assert stats.vm_coverage == 0.75
+    # exporter surface: the gauge rides the standard generation table
+    from fks_tpu.obs.exporter import GENERATION_GAUGES
+    assert any(key == "vm_coverage" for _, key, _ in GENERATION_GAUGES)

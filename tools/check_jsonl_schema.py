@@ -82,6 +82,11 @@ EVENT_KIND_REQUIRED: Dict[str, Tuple[str, ...]] = {
     # evolve WAL replay: a resumed generation reused persisted
     # candidates/evals instead of re-spending LLM calls / device evals
     "resume_wal": ("generation",),
+    # VM-native serving (fks_tpu.serve.vm_engine + cli serve /
+    # promotion controller): one event per champion table hot-swap
+    # (outcome="swapped") or per AOT fallback when a champion is outside
+    # the VM vocabulary (outcome="fallback")
+    "vm_swap": ("outcome", "champion"),
     # causal tracing (fks_tpu.obs.trace_ctx): one span of a request /
     # generation / promotion trace. parent_id is intentionally NOT
     # required: the root span carries an explicit JSON null there, and
@@ -102,6 +107,12 @@ CANDIDATE_REJECT_TAXONOMY = {
 #: legal event kinds inside an embedded decision-trace row (must match
 #: fks_tpu.sim.types.TRACE_KIND_NAMES)
 TRACE_EVENT_KINDS = {"CREATE", "DELETE", "RETRY", "NODE_DOWN", "NODE_UP"}
+
+#: legal ``outcome`` values on a vm_swap event, and legal ``engine_kind``
+#: values wherever the field appears (promotion_event / vm_swap /
+#: serve meta) — which champion-binding strategy served the swap
+VM_SWAP_OUTCOMES = {"swapped", "fallback"}
+ENGINE_KINDS = {"aot", "vm"}
 METRIC_KIND_REQUIRED: Dict[str, Tuple[str, ...]] = {
     "generation": ("generation", "best_score"),
     "parity": ("generation", "checked", "max_drift"),
@@ -209,6 +220,14 @@ def check_kinds(path: str, records: List[dict],
     ``kind`` is in the known vocabulary must carry that kind's required
     keys. Raises ``SchemaError`` naming the record index."""
     for i, rec in enumerate(records):
+        # engine_kind is optional everywhere it appears (promotion_event,
+        # vm_swap, serve summaries), but when present it must name a real
+        # champion-binding strategy
+        if "engine_kind" in rec and rec["engine_kind"] not in ENGINE_KINDS:
+            raise SchemaError(
+                f"{path}: record {i + 1}: unknown engine_kind "
+                f"{rec['engine_kind']!r} (expect one of "
+                f"{sorted(ENGINE_KINDS)})")
         required = kind_required.get(rec.get("kind", ""))
         if not required:
             continue
@@ -224,6 +243,12 @@ def check_kinds(path: str, records: List[dict],
                     f"{path}: record {i + 1}: unknown rejection taxonomy "
                     f"{tax!r} (expect one of "
                     f"{sorted(CANDIDATE_REJECT_TAXONOMY)})")
+        elif rec.get("kind") == "vm_swap":
+            out = rec.get("outcome")
+            if out not in VM_SWAP_OUTCOMES:
+                raise SchemaError(
+                    f"{path}: record {i + 1}: unknown vm_swap outcome "
+                    f"{out!r} (expect one of {sorted(VM_SWAP_OUTCOMES)})")
         elif rec.get("kind") == "decision_trace":
             _check_embedded_events(path, i, rec.get("events", []))
         elif rec.get("kind") == "trace_diff":

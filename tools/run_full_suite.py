@@ -71,6 +71,15 @@ degrade-then-recover, SIGTERM drain, WAL resume mid-generation) from
 means the shed/degrade/drain/WAL machinery the serve and evolve loops
 lean on under faults no longer holds its invariants. Recorded as
 ``resilience_gate``.
+
+A VM SERVE GATE follows: the champion-as-data serving path —
+``cli serve --serve-engine vm --selftest`` must answer with exact
+parity against the unbatched reference (exit 0), and the double
+hot-swap drill (``cli pipeline --drill --only vm_double_swap``) must
+promote TWICE through the live controller with zero XLA compiles on
+the serving process. A failure means the VM engine's program tables,
+the shared executables, or the zero-rebuild swap path regressed to
+recompiling. Recorded as ``vm_serve_gate``.
 """
 from __future__ import annotations
 
@@ -263,6 +272,35 @@ def resilience_gate() -> dict:
     return {"ok": ok, **detail}
 
 
+def vm_serve_gate() -> dict:
+    """VM-native serving: the champion-as-data selftest (engine_kind
+    "vm", exact parity vs the unbatched reference) must exit 0, and the
+    double hot-swap drill must perform two in-place promotions with
+    ZERO XLA compiles (``pipeline --drill --only vm_double_swap``).
+    Returns {"ok": bool, ...}."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    detail = {}
+    ok = True
+    steps = (
+        ("selftest", [sys.executable, "-m", "fks_tpu.cli", "serve",
+                      "--cpu", "--serve-engine", "vm",
+                      "--selftest", "4", "--pods-per-query", "3",
+                      "--max-pods", "16", "--max-batch", "4"]),
+        ("double_swap", [sys.executable, "-m", "fks_tpu.cli", "pipeline",
+                         "--cpu", "--drill", "--only", "vm_double_swap"]),
+    )
+    for name, cmd in steps:
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              cwd=REPO, env=env, timeout=900)
+        detail[f"{name}_rc"] = proc.returncode
+        if proc.returncode != 0:
+            ok = False
+            detail[f"{name}_err"] = (proc.stderr
+                                     or proc.stdout or "")[-500:]
+            break
+    return {"ok": ok, **detail}
+
+
 def _write_history(root: str, values) -> None:
     now = time.time()
     for i, v in enumerate(values):
@@ -336,6 +374,9 @@ def main() -> int:
     rgate = resilience_gate()
     if not rgate["ok"]:
         print(f"RESILIENCE GATE FAILED: {rgate}", file=sys.stderr)
+    mgate = vm_serve_gate()
+    if not mgate["ok"]:
+        print(f"VM SERVE GATE FAILED: {mgate}", file=sys.stderr)
     wgate = span_trace_gate()
     if not wgate["ok"]:
         print(f"SPAN TRACE GATE FAILED: {wgate}", file=sys.stderr)
@@ -351,7 +392,8 @@ def main() -> int:
         r"(\d+) (passed|failed|error|skipped|deselected|xfailed)", summary)}
     gates_ok = (gate["ok"] and tgate["ok"] and sgate["ok"] and vgate["ok"]
                 and hgate["ok"] and lgate["ok"] and ngate["ok"]
-                and pgate["ok"] and rgate["ok"] and wgate["ok"])
+                and pgate["ok"] and rgate["ok"] and wgate["ok"]
+                and mgate["ok"])
     rc = proc.returncode if gates_ok else (proc.returncode or 1)
     row = {"ts": round(time.time(), 1), "rev": rev, "rc": rc,
            "wall_s": wall, **counts, "obs_gate": gate,
@@ -359,7 +401,7 @@ def main() -> int:
            "sharded_serve_gate": hgate, "lint_gate": lgate,
            "trends_gate": ngate, "promote_gate": pgate,
            "resilience_gate": rgate, "span_trace_gate": wgate,
-           "summary": summary}
+           "vm_serve_gate": mgate, "summary": summary}
     os.makedirs(os.path.dirname(OUT), exist_ok=True)
     with open(OUT, "a") as f:
         f.write(json.dumps(row) + "\n")
