@@ -230,6 +230,12 @@ def _fallback_json(error: str, failure_taxonomy=None) -> str:
         payload["vs_baseline"] = round(
             float(stale["value"]) / BASELINE_EVALS_PER_SEC, 3)
         payload["stale_from_run"] = stale
+        # the donor's memory budgets ride along top-level so the budget
+        # trend stays populated across a failed probe; the stale marker
+        # keeps them baseline-only in obs.compare (candidate side skips)
+        for key in ("peak_device_bytes", "exe_temp_bytes"):
+            if key in stale:
+                payload[key] = stale[key]
     if banked is not None:
         payload["banked_from"] = banked
     if stale is not None:
@@ -445,15 +451,21 @@ def _cost_estimates(fn, *args) -> dict:
     return out
 
 
-def _memory_estimates(fn, *args) -> dict:
+def _memory_estimates(fn, *args, exe_key: str = "") -> dict:
     """Compiled-program memory footprint for the jitted ``fn`` at these
     args: {"peak_live_bytes": ..., "temp_bytes": ...}. Peak live =
     arguments + outputs + temporaries as reported by XLA's
     ``memory_analysis()`` — the compile-time answer to "does this shape
     fit", which CompileWatcher (a timing listener) cannot provide. Same
-    AOT / degrade-to-{} contract as ``_cost_estimates``."""
+    AOT / degrade-to-{} contract as ``_cost_estimates``.
+
+    The same two numbers also land under the budget-gate vocabulary
+    (``peak_device_bytes``/``exe_temp_bytes`` — obs.compare judges both
+    as must-not-regress), and when ``exe_key`` is set the executable is
+    filed in the footprint ledger under component "bench"."""
     try:
-        mem = fn.lower(*args).compile().memory_analysis()
+        compiled = fn.lower(*args).compile()
+        mem = compiled.memory_analysis()
     except Exception as e:  # noqa: BLE001 — estimates are best-effort
         log(f"memory_analysis unavailable: {type(e).__name__}: {e}")
         return {}
@@ -467,7 +479,36 @@ def _memory_estimates(fn, *args) -> dict:
         return {}
     out["peak_live_bytes"] = live
     out["temp_bytes"] = temp
+    out["peak_device_bytes"] = live
+    out["exe_temp_bytes"] = temp
+    if exe_key:
+        try:
+            from fks_tpu.obs.memory import record_footprint
+            record_footprint("bench", exe_key, compiled)
+        except Exception as e:  # noqa: BLE001 — ledger is best-effort
+            log(f"footprint ledger unavailable: {e}")
     return out
+
+
+def _ledger_budget_keys(*components: str) -> dict:
+    """``peak_device_bytes``/``exe_temp_bytes`` out of the in-process
+    footprint ledger (obs.memory): the largest predicted claim among the
+    stage's compiled executables — serve engines file every AOT build
+    there, so the stage payload carries the budget-gate vocabulary
+    without re-lowering anything. Empty dict when nothing was filed
+    (backend without memory_analysis)."""
+    try:
+        from fks_tpu.obs.memory import LEDGER
+        recs = [r for r in LEDGER.records()
+                if not components or r.get("component") in components]
+    except Exception:  # noqa: BLE001 — budgets are best-effort
+        return {}
+    if not recs:
+        return {}
+    return {"peak_device_bytes": max(int(r.get("total_bytes", 0))
+                                     for r in recs),
+            "exe_temp_bytes": max(int(r.get("temp_bytes", 0))
+                                  for r in recs)}
 
 
 def stage_parity(engine: str) -> int:
@@ -1077,7 +1118,8 @@ def stage_scale1k(gate: str = "") -> int:
     bstate0 = jax.tree_util.tree_map(
         lambda leaf: jnp.broadcast_to(leaf, (pop,) + leaf.shape), state0)
     analysis = {**_cost_estimates(run.advance, params, bstate0),
-                **_memory_estimates(run.advance, params, bstate0)}
+                **_memory_estimates(run.advance, params, bstate0,
+                                    exe_key=f"scale1k,pop={pop}")}
 
     payload = {
         "scale1k_events_per_sec": round(eps, 1),
@@ -1288,6 +1330,8 @@ def stage_serve(gate: str = "") -> int:
     payload["trace_overhead_pct"] = round(trace_overhead_pct, 3)
     payload.update({f"trace_{c}_ms": round(v, 4)
                     for c, v in trace_comp_ms.items()})
+    # memory budgets (round 20; additive keys gated must-not-regress)
+    payload.update(_ledger_budget_keys("serve_aot"))
     _record("metric", "bench_stage", payload, stage="serve",
             platform="cpu")
     _record("metric", "snapshot_cache", dict(cache))
@@ -1442,6 +1486,8 @@ def stage_serve_sharded(gate: str, devices: int) -> int:
         "engine": "flat", "state_pack": True,
         "policy_tier": engine.policy_tier,
         "champion_score": round(champion.score, 4),
+        # memory budgets (round 20; additive keys gated must-not-regress)
+        **_ledger_budget_keys("serve_aot"),
     }
     _record("metric", "bench_stage", payload, stage="serve_sharded",
             platform="cpu")
@@ -1605,6 +1651,8 @@ def stage_promote(gate: str = "") -> int:
         "vm_promote_compiles": vm_compiles,
         "vm_promoted": int(vm_promoted),
         "nodes": nodes, "engine": "flat",
+        # memory budgets across both promotion paths (round 20)
+        **_ledger_budget_keys("serve_aot", "serve_vm"),
     }
     _record("metric", "bench_stage", payload, stage="promote",
             platform="cpu")

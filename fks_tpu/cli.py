@@ -22,6 +22,7 @@ Usage:
     python -m fks_tpu.cli trace-diff --engines exact,flat [--policy P | --code F]
     python -m fks_tpu.cli scenarios [--suite NAME [--scenario I]]
     python -m fks_tpu.cli lint [PATHS...] [--write-pins | --no-pins]
+    python -m fks_tpu.cli mem [--run-dir DIR | --sample | --drill NAME]
     python -m fks_tpu.cli traces
 
 Every subcommand accepts ``--run-dir DIR`` to flight-record the run
@@ -1243,6 +1244,66 @@ def cmd_scenarios(args):
     return 0
 
 
+def cmd_mem(args):
+    """Memory observability (fks_tpu.obs.memory). Three modes:
+
+    - view (default): render the memory view of a recorded run from
+      ``--run-dir``'s JSONL alone — the executable footprint ladder
+      (every compiled program's predicted HBM claim, largest first),
+      the per-mesh-layout roll-up, the watermark sampler's host/device
+      table, and the leak sentinel's verdict per fenced loop;
+    - ``--sample``: take one live watermark sample (host RSS +
+      normalized per-device ``memory_stats``) and print it as JSON;
+    - ``--drill NAME``: run one deterministic memory drill and exit
+      0/1 on its verdict — ``vm_swap_leak`` hammers ``swap_program``
+      against interleaved serve batches inside a live-array fence
+      (zero net drift required), ``snapshot_cache_bound`` proves the
+      device snapshot cache respects a byte ceiling under distinct
+      query shapes. Both record into ``--run-dir`` when given."""
+    if args.drill:
+        _apply_platform_flags(args)
+        from fks_tpu.obs import get_recorder
+        from fks_tpu.obs.memory import run_drill
+
+        kw = {}
+        if args.drill == "vm_swap_leak":
+            kw = {"swaps": args.swaps, "batches": args.batches}
+        with _flight_recorder(args, "mem"):
+            res = run_drill(args.drill, recorder=get_recorder(), **kw)
+        print(json.dumps(res))
+        return 0 if res.get("ok") else 1
+    if args.sample:
+        _apply_platform_flags(args)
+        from fks_tpu.obs.memory import WatermarkSampler
+
+        sampler = WatermarkSampler(enabled=True, trace_host=True)
+        sampler.start()
+        try:
+            rec = sampler.sample(stage="cli")
+        finally:
+            sampler.stop()
+        print(json.dumps(rec))
+        return 0
+    if not args.run_dir:
+        print("error: mem needs --run-dir DIR (view mode), --sample, or "
+              "--drill NAME", file=sys.stderr)
+        return 2
+    from fks_tpu.obs.report import _memory_section, load_run
+
+    try:
+        _meta, _events, metrics = load_run(args.run_dir)
+    except FileNotFoundError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    lines = _memory_section(metrics)
+    if not lines:
+        print(f"(no memory records in {args.run_dir} — footprints land "
+              "when an instrumented command compiles under --run-dir)")
+        return 0
+    print("\n".join(lines))
+    return 0
+
+
 def cmd_traces(args):
     """Dataset discovery (reference: parser.py:103-115)."""
     from fks_tpu.data import TraceParser
@@ -1689,6 +1750,31 @@ def main(argv=None) -> int:
                     help="flight-recorder run directory for the "
                          "lint_report record")
     ln.set_defaults(fn=cmd_lint)
+
+    mm = sub.add_parser(
+        "mem",
+        help="memory observability: footprint ladder / watermark view "
+             "of a run, one live sample, or a leak drill (exit 1 on a "
+             "failed drill)",
+        parents=[common])
+    mm.add_argument("--drill",
+                    choices=("vm_swap_leak", "snapshot_cache_bound"),
+                    default="",
+                    help="run one deterministic memory drill and exit "
+                         "0/1 on its verdict")
+    mm.add_argument("--swaps", type=int, default=50,
+                    help="vm_swap_leak: swap_program iterations "
+                         "(default 50)")
+    mm.add_argument("--batches", type=int, default=200,
+                    help="vm_swap_leak: interleaved serve batches "
+                         "(default 200)")
+    mm.add_argument("--sample", action="store_true",
+                    help="take one live watermark sample (host RSS + "
+                         "per-device memory_stats) and print it as JSON")
+    mm.add_argument("--devices", type=int, default=0,
+                    help="with --cpu: size of the virtual CPU device "
+                         "mesh the drill runs against")
+    mm.set_defaults(fn=cmd_mem)
 
     t = sub.add_parser("traces", help="list available trace files")
     t.set_defaults(fn=cmd_traces)

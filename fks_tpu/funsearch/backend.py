@@ -288,6 +288,34 @@ class CodeEvaluator:
                 on_segment=self._count_segment)
         return self._vm_mesh_run
 
+    def _maybe_record_vm_footprint(self, run, stacked, pop: int) -> None:
+        """Evolve-tier footprint ledger entry: price this bucket's
+        population runner once per (pop, capacity) bucket — only while a
+        flight recorder is on (the AOT lower is not free, so the silent
+        path pays nothing) and only for runners that expose ``.lower``
+        (the plain jitted path; segmented/mesh runners manage their own
+        inner jits and stay unpriced)."""
+        from fks_tpu.obs.recorder import get_recorder
+        rec = get_recorder()
+        if not rec.enabled or getattr(run, "lower", None) is None:
+            return
+        cap = int(stacked.opcode.shape[-1])
+        key = (pop, cap)
+        done = getattr(self, "_footprinted_buckets", None)
+        if done is None:
+            done = self._footprinted_buckets = set()
+        if key in done:
+            return
+        done.add(key)
+        try:
+            from fks_tpu.obs.memory import record_footprint
+            compiled = run.lower(stacked, self.state0).compile()
+            record_footprint("evolve", f"pop={pop},cap={cap}", compiled,
+                             mesh=self.mesh, recorder=rec,
+                             engine=self.engine)
+        except Exception:  # noqa: BLE001 — pricing is best-effort
+            pass
+
     def _run_vm_batch(self, progs: List[vm.VMProgram]) -> List[SimResult]:
         """Evaluate stacked VM candidates in ONE device launch — sharded
         over the mesh when one with >1 device was passed.
@@ -304,6 +332,12 @@ class CodeEvaluator:
         pop = vm.bucket_lanes(len(progs), self._n_shards)
         padded = list(progs) + [progs[-1]] * (pop - len(progs))
         stacked = vm.stack_programs(padded)
+        # footprint the bucket's runner BEFORE the span: the once-per-
+        # bucket AOT lower must not land on the vm_batch device clock
+        # (same branch condition as the dispatch below)
+        if not (self._n_shards > 1 and self.suite is None):
+            self._maybe_record_vm_footprint(self._vm_pop_runner(),
+                                            stacked, pop)
         # the span's clock covers the device work AND the one transfer:
         # device_get materializes the whole generation, so no extra sync
         with span("vm_batch", candidates=len(progs), lanes=pop,

@@ -32,6 +32,8 @@ jitted code.
                   compute split + occupancy (``device_profile`` metrics)
 - ``history``   — cross-run index, trend/regression flagging, auto
                   baselines, SLO burn rates (``cli trends``)
+- ``memory``    — executable-footprint ledger, watermark sampler, leak
+                  sentinel + drills (``cli mem``, ``fks_mem_*`` gauges)
 """
 from fks_tpu.obs.compare import (
     DEFAULT_THRESHOLDS, Threshold, compare_runs, extract_metrics,
@@ -44,6 +46,11 @@ from fks_tpu.obs.history import (
     RunHistory, SLOConfig, record_slo_burn, resolve_auto_baseline, slo_burn,
 )
 from fks_tpu.obs.ledger import EvolutionLedger
+from fks_tpu.obs.memory import (
+    LEAK_LOOPS, MEMORY_COMPONENTS, NULL_SAMPLER, FootprintLedger,
+    LeakSentinel, WatermarkSampler, footprint_of, leak_fence,
+    live_array_stats, record_footprint, rollup, run_drill,
+)
 from fks_tpu.obs.profiler import (
     NULL_PROFILER, StageProfiler, profile_launch,
 )
@@ -62,8 +69,8 @@ from fks_tpu.obs.tracing import (
     trace_diff,
 )
 from fks_tpu.obs.telemetry import (
-    CompileWatcher, device_snapshot, mesh_snapshot, record_devices,
-    record_mesh, watch_compiles,
+    CompileWatcher, device_snapshot, mesh_snapshot, normalize_memory_stats,
+    record_devices, record_mesh, watch_compiles,
 )
 from fks_tpu.obs.watchdog import (
     FLAG_INF, FLAG_NAN, FLAG_RANGE, ParitySentinel, check_result,
@@ -71,18 +78,22 @@ from fks_tpu.obs.watchdog import (
 )
 
 __all__ = [
-    "DEFAULT_THRESHOLDS", "FLAG_INF", "FLAG_NAN", "FLAG_RANGE", "NULL",
-    "NULL_PROFILER", "CompileWatcher", "EvolutionLedger", "FlightRecorder",
-    "NullRecorder", "ParitySentinel", "RunHistory", "SLOConfig",
-    "StageProfiler", "Threshold", "align_traces", "candidate_trace_diff",
+    "DEFAULT_THRESHOLDS", "FLAG_INF", "FLAG_NAN", "FLAG_RANGE",
+    "LEAK_LOOPS", "MEMORY_COMPONENTS", "NULL", "NULL_PROFILER",
+    "NULL_SAMPLER", "CompileWatcher", "EvolutionLedger", "FlightRecorder",
+    "FootprintLedger", "LeakSentinel", "NullRecorder", "ParitySentinel",
+    "RunHistory", "SLOConfig", "StageProfiler", "Threshold",
+    "WatermarkSampler", "align_traces", "candidate_trace_diff",
     "check_result", "combined_flags", "compare_runs", "describe_flags",
     "device_snapshot", "extract_metrics", "extract_trace",
-    "format_comparison", "format_diff", "get_recorder", "has_regression",
-    "health_line", "mesh_snapshot", "parse_threshold_overrides",
-    "profile_launch", "record_devices", "record_mesh", "record_slo_burn",
-    "recording", "render_report", "resolve_auto_baseline", "run_health",
-    "slo_burn", "span", "span_path", "sparkline", "to_openmetrics",
-    "trace_diff", "watch", "watch_compiles", "TraceContext",
-    "activate_trace", "critical_path", "current_trace", "emit_span",
-    "new_trace", "render_waterfall", "trace_ctx",
+    "footprint_of", "format_comparison", "format_diff", "get_recorder",
+    "has_regression", "health_line", "leak_fence", "live_array_stats",
+    "mesh_snapshot", "normalize_memory_stats",
+    "parse_threshold_overrides", "profile_launch", "record_devices",
+    "record_footprint", "record_mesh", "record_slo_burn", "recording",
+    "render_report", "resolve_auto_baseline", "rollup", "run_drill",
+    "run_health", "slo_burn", "span", "span_path", "sparkline",
+    "to_openmetrics", "trace_diff", "watch", "watch_compiles",
+    "TraceContext", "activate_trace", "critical_path", "current_trace",
+    "emit_span", "new_trace", "render_waterfall", "trace_ctx",
 ]

@@ -87,6 +87,14 @@ DEFAULT_THRESHOLDS: Dict[str, Threshold] = {
                                    abs_tol=2.0),
     "vm_swap_h2d_bytes": Threshold(higher_is_better=False,
                                    rel=0.0, abs_tol=64.0),
+    # memory budgets (obs.memory / bench stages): the run's peak
+    # predicted device bytes and the largest executable's XLA scratch
+    # claim must not grow — one 4 KiB page of absolute floor absorbs
+    # buffer-assignment jitter at tiny CPU shapes, any real growth gates
+    "peak_device_bytes": Threshold(higher_is_better=False, rel=0.0,
+                                   abs_tol=4096.0),
+    "exe_temp_bytes": Threshold(higher_is_better=False, rel=0.0,
+                                abs_tol=4096.0),
     # static pre-flight (bench stage_preflight): the fraction of the
     # candidate stream rejected before sandbox/transpile must not drop
     # more than 5 points — a drop means the analyzer stopped catching a
@@ -138,6 +146,13 @@ def _from_run_dir(run_dir: str) -> Dict[str, float]:
             v = _num(m.get(key))
             if v is not None:
                 out[key] = min(out.get(key, v), v)
+        # memory budgets: WORST (highest) observation — a peak metric's
+        # whole point is the high-water mark, so the gate judges the
+        # largest claim any stage recorded
+        for key in ("peak_device_bytes", "exe_temp_bytes"):
+            v = _num(m.get(key))
+            if v is not None:
+                out[key] = max(out.get(key, 0.0), v)
         v = _num(m.get("compile_seconds"))
         if v is not None:
             out["compile_seconds"] = out.get("compile_seconds", 0.0) + v
@@ -168,7 +183,7 @@ def _from_jsonl(path: str, allow_stale: bool = False) -> Dict[str, float]:
     candidate it would mask the very failure it records."""
     out: Dict[str, float] = {}
 
-    def take(rec: Dict[str, Any]) -> None:
+    def take(rec: Dict[str, Any], stale: bool = False) -> None:
         for key in ("evals_per_sec", "code_evals_per_sec",
                     "compile_seconds", "best_score", "median_score",
                     "parity_max_drift", "budget_speedup",
@@ -176,14 +191,25 @@ def _from_jsonl(path: str, allow_stale: bool = False) -> Dict[str, float]:
                     "serve_p99_ms", "serve_qps", "serve_sharded_qps",
                     "serve_h2d_bytes_per_query", "preflight_reject_rate",
                     "trace_overhead_pct", "promotion_swap_ms",
-                    "vm_swap_h2d_bytes"):
+                    "vm_swap_h2d_bytes", "peak_device_bytes",
+                    "exe_temp_bytes"):
             v = _num(rec.get(key))
             if v is None:
+                continue
+            # memory budgets on a STALE fallback line are carried-forward
+            # donor evidence, not a live measurement — the same baseline-
+            # only asymmetry as the stale headline (take() runs on every
+            # record, so the guard must live here, not at the call site)
+            if (stale and not allow_stale
+                    and key in ("peak_device_bytes", "exe_temp_bytes")):
                 continue
             if key in ("compile_seconds", "serve_p99_ms",
                        "serve_h2d_bytes_per_query", "trace_overhead_pct",
                        "promotion_swap_ms", "vm_swap_h2d_bytes"):
                 out[key] = min(out.get(key, v), v)
+            elif key in ("peak_device_bytes", "exe_temp_bytes"):
+                # peak metrics: the high-water mark across records
+                out[key] = max(out.get(key, 0.0), v)
             else:
                 out[key] = max(out.get(key, v), v)
 
@@ -205,9 +231,10 @@ def _from_jsonl(path: str, allow_stale: bool = False) -> Dict[str, float]:
                 if v and (allow_stale or "stale_from_run" not in rec):
                     out["evals_per_sec"] = max(
                         out.get("evals_per_sec", 0.0), v)
-            take(rec)
+            stale = "stale_from_run" in rec
+            take(rec, stale=stale)
             if isinstance(rec.get("result"), dict):
-                take(rec["result"])
+                take(rec["result"], stale=stale)
     return out
 
 

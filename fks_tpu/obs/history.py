@@ -51,7 +51,7 @@ INDEX_NAME = "history.jsonl"
 TREND_METRICS = (
     "evals_per_sec", "code_evals_per_sec", "compile_seconds",
     "best_score", "serve_p99_ms", "serve_qps", "scale1k_events_per_sec",
-    "budget_speedup",
+    "budget_speedup", "peak_device_bytes", "exe_temp_bytes",
 )
 
 
@@ -267,13 +267,21 @@ class RunHistory:
     def last_healthy_headline(self) -> Optional[Dict[str, Any]]:
         """The NEWEST healthy entry with a measured ``evals_per_sec``
         headline — the stale-fallback donor for a failed bench probe.
-        Returns ``{"value", "run", "path", "ts"}`` or None."""
+        Returns ``{"value", "run", "path", "ts"}``, plus the donor's
+        memory budgets (``peak_device_bytes``/``exe_temp_bytes``) when it
+        recorded them — a failed probe's fallback line can then keep the
+        budget trend populated (explicitly stale: compare's candidate
+        side ignores them), or None."""
         if not self.entries:
             self.scan()
         for e in reversed(self.entries):
             if e["healthy"] and e["metrics"].get("evals_per_sec"):
-                return {"value": e["metrics"]["evals_per_sec"],
-                        "run": e["run"], "path": e["path"], "ts": e["ts"]}
+                out = {"value": e["metrics"]["evals_per_sec"],
+                       "run": e["run"], "path": e["path"], "ts": e["ts"]}
+                for key in ("peak_device_bytes", "exe_temp_bytes"):
+                    if key in e["metrics"]:
+                        out[key] = e["metrics"][key]
+                return out
         return None
 
 

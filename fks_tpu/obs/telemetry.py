@@ -129,9 +129,42 @@ def watch_compiles(recorder=None):
 
 # --------------------------------------------------------- snapshots
 
+#: canonical memory_stats keys -> the per-backend spellings observed in
+#: the wild (TPU/GPU PJRT report bytes_in_use/peak_bytes_in_use; some
+#: stacks spell the pool limit bytes_limit vs bytes_reservable_limit)
+_MEMORY_STAT_ALIASES = (
+    ("bytes_in_use", ("bytes_in_use", "bytes_used", "used_bytes")),
+    ("peak_bytes_in_use", ("peak_bytes_in_use", "peak_bytes",
+                           "max_bytes_in_use", "largest_alloc_size")),
+    ("bytes_limit", ("bytes_limit", "bytes_reservable_limit",
+                     "pool_bytes", "limit_bytes")),
+)
+
+
+def normalize_memory_stats(raw: Any) -> Optional[Dict[str, int]]:
+    """Canonicalize a backend's ``Device.memory_stats()`` dict to the
+    closed ``bytes_in_use`` / ``peak_bytes_in_use`` / ``bytes_limit``
+    subset every downstream reader (report tables, ``fks_mem_*`` gauges,
+    the watermark sampler) keys on. Backends that don't report — CPU
+    returns None, some raise — normalize to None; partial dicts keep
+    whichever canonical keys they can answer, so a reader never KeyErrors
+    on a backend-specific spelling."""
+    if not isinstance(raw, dict) or not raw:
+        return None
+    out: Dict[str, int] = {}
+    for canon, spellings in _MEMORY_STAT_ALIASES:
+        for k in spellings:
+            v = raw.get(k)
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                out[canon] = int(v)
+                break
+    return out or None
+
+
 def device_snapshot() -> List[Dict[str, Any]]:
-    """Per-device identity + ``memory_stats()`` (None where the backend
-    doesn't report — CPU — rather than raising)."""
+    """Per-device identity + normalized ``memory_stats()`` (None where
+    the backend doesn't report — CPU — rather than raising; key spellings
+    canonicalized by ``normalize_memory_stats``)."""
     out = []
     for d in jax.devices():
         try:
@@ -143,7 +176,7 @@ def device_snapshot() -> List[Dict[str, Any]]:
             "platform": d.platform,
             "device_kind": getattr(d, "device_kind", ""),
             "process_index": getattr(d, "process_index", 0),
-            "memory_stats": mem,
+            "memory_stats": normalize_memory_stats(mem),
         })
     return out
 

@@ -44,6 +44,7 @@ import numpy as np
 
 from fks_tpu import obs
 from fks_tpu.data.entities import ClusterArrays, Workload
+from fks_tpu.obs.memory import record_footprint
 from fks_tpu.parallel.mesh import (
     make_sharded_serve_fn, num_shards, occupancy_stats, pad_population,
     serve_lane_count, serve_sharding,
@@ -341,6 +342,7 @@ class ServeEngine:
                  state_pack: bool = False,
                  max_steps_factor: int = 8,
                  mesh=None,
+                 snapshot_cache_max_bytes: int = 0,
                  recorder=None, profiler=None):
         if engine == "fused":
             raise ValueError(
@@ -367,9 +369,15 @@ class ServeEngine:
         self.mesh = mesh
         self._shards = num_shards(mesh) if mesh is not None else 1
         self._sharding = serve_sharding(mesh) if mesh is not None else None
-        # device-resident snapshot tables: content-hash -> device buffer
-        self._ktable_cache: "OrderedDict[Tuple, Any]" = OrderedDict()
+        # device-resident snapshot tables: content-hash -> (buffer, bytes)
+        self._ktable_cache: "OrderedDict[Tuple, Tuple[Any, int]]" = \
+            OrderedDict()
         self._ktable_cache_cap = 32
+        # byte ceiling on the resident tables (0 = count-capped only):
+        # the LRU evicts until BOTH the entry cap and the byte cap hold,
+        # so a configured HBM budget is a hard bound, not a suggestion
+        self._ktable_cache_max_bytes = int(snapshot_cache_max_bytes)
+        self._ktable_cache_bytes = 0
         self.snapshot_cache_hits = 0
         self.snapshot_cache_misses = 0
         # H2D accounting (bytes actually shipped per answered query)
@@ -541,6 +549,12 @@ class ServeEngine:
                         .lower(*example).compile()
         self._compiled[key] = compiled
         self.cold_compiles += 1
+        # executable-footprint ledger: every ladder rung's predicted HBM
+        # claim (memory_analysis) is one memory_footprint record
+        record_footprint("serve_aot", f"lanes={lanes},pods={pod_bucket}",
+                         compiled, mesh=self.mesh, recorder=self.recorder,
+                         engine=self.engine_name,
+                         engine_kind=self.engine_kind)
         return compiled
 
     def warmup(self, lane_buckets: Optional[Sequence[int]] = None,
@@ -575,7 +589,14 @@ class ServeEngine:
             "h2d_bytes_total": int(self.h2d_bytes_total),
             "h2d_bytes_per_query": (self.h2d_bytes_total / self.h2d_queries
                                     if self.h2d_queries else 0.0),
+            "bytes": int(self._ktable_cache_bytes),
+            "max_bytes": int(self._ktable_cache_max_bytes),
         }
+
+    @property
+    def snapshot_cache_bytes(self) -> int:
+        """Bytes of snapshot tables currently resident in the cache."""
+        return int(self._ktable_cache_bytes)
 
     def _ktable_for(self, lanes: int, bucket: int, kt: np.ndarray):
         """The device-resident snapshot-table buffer for this batch:
@@ -589,15 +610,22 @@ class ServeEngine:
         if hit is not None:
             self._ktable_cache.move_to_end(key)
             self.snapshot_cache_hits += 1
-            return hit
+            return hit[0]
         self.snapshot_cache_misses += 1
         padded = self._pad_kt(kt, lanes)
         dev = (jax.device_put(padded, self._sharding)
                if self._sharding is not None else jnp.asarray(padded))
-        self.h2d_bytes_total += int(padded.nbytes)
-        self._ktable_cache[key] = dev
-        while len(self._ktable_cache) > self._ktable_cache_cap:
-            self._ktable_cache.popitem(last=False)
+        nbytes = int(padded.nbytes)
+        self.h2d_bytes_total += nbytes
+        self._ktable_cache[key] = (dev, nbytes)
+        self._ktable_cache_bytes += nbytes
+        while self._ktable_cache and (
+                len(self._ktable_cache) > self._ktable_cache_cap
+                or (self._ktable_cache_max_bytes
+                    and self._ktable_cache_bytes
+                    > self._ktable_cache_max_bytes)):
+            _, (_, freed) = self._ktable_cache.popitem(last=False)
+            self._ktable_cache_bytes -= freed
         return dev
 
     def answer_batch(self, pod_lists: Sequence[Sequence[dict]]) -> List[dict]:

@@ -388,3 +388,61 @@ def test_generation_stats_carries_vm_coverage():
     # exporter surface: the gauge rides the standard generation table
     from fks_tpu.obs.exporter import GENERATION_GAUGES
     assert any(key == "vm_coverage" for _, key, _ in GENERATION_GAUGES)
+
+
+def test_concurrent_swap_never_tears_a_batch(wl, envelope):
+    """ISSUE-17 thread-race criterion: ``swap_program`` racing in-flight
+    ``answer_batch`` calls must be atomic per batch — every answer set
+    matches ONE of the two champions exactly (the engine's swap lock
+    holds across a batch), never a torn mix of old tables and new
+    params, and the race must not leak or recompile."""
+    import threading
+
+    # behaviorally OPPOSED champions (worst-fit vs best-fit) so the two
+    # programs place differently — a torn swap has something to tear
+    champs = [_champ("score = node.cpu_milli_left - pod.cpu_milli",
+                     0.4, source="<a>"),
+              _champ("score = pod.cpu_milli - node.cpu_milli_left",
+                     0.9, source="<b>")]
+    eng = VMServeEngine(champs[0], wl, envelope=envelope, engine="flat")
+    queries = [_query(7), _query(11)]
+
+    def key(answers):
+        return tuple((round(float(a["score"]), 9), tuple(a["placements"]))
+                     for a in answers)
+
+    # one reference answer set per champion, from the same engine while
+    # it is single-threaded (VM answers are deterministic per program)
+    legal = {}
+    for i, c in enumerate(champs):
+        eng.swap_program(c)
+        legal[i] = key(eng.answer_batch(queries))
+    assert legal[0] != legal[1]  # the race has something to tear
+
+    watcher = CompileWatcher().install()
+    errors, torn = [], []
+    stop = threading.Event()
+
+    def hammer():
+        try:
+            while not stop.is_set():
+                got = key(eng.answer_batch(queries))
+                if got not in (legal[0], legal[1]):
+                    torn.append(got)
+        except Exception as e:  # pragma: no cover - failure diagnostics
+            errors.append(repr(e))
+
+    threads = [threading.Thread(target=hammer) for _ in range(3)]
+    for t in threads:
+        t.start()
+    try:
+        for i in range(30):
+            eng.swap_program(champs[(i + 1) % 2])
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=60)
+        watcher.uninstall()
+    assert not errors, errors
+    assert not torn, f"{len(torn)} torn batches, first: {torn[:1]}"
+    assert watcher.backend_compile_count == 0  # swaps never rebuild

@@ -113,6 +113,15 @@ TRACE_EVENT_KINDS = {"CREATE", "DELETE", "RETRY", "NODE_DOWN", "NODE_UP"}
 #: serve meta) — which champion-binding strategy served the swap
 VM_SWAP_OUTCOMES = {"swapped", "fallback"}
 ENGINE_KINDS = {"aot", "vm"}
+
+#: legal ``component`` values on a memory_footprint record — which tier
+#: compiled the executable (duplicated from fks_tpu.obs.memory
+#: .MEMORY_COMPONENTS; tests/test_memory.py pins the two copies)
+MEMORY_COMPONENTS = {"serve_aot", "serve_vm", "evolve", "bench"}
+#: legal ``loop`` values on a leak_check record (fks_tpu.obs.memory
+#: .LEAK_LOOPS) — which hot loop the leak sentinel fenced
+LEAK_LOOPS = {"serve_batch", "vm_swap", "promotion", "evolve_generation",
+              "drill"}
 METRIC_KIND_REQUIRED: Dict[str, Tuple[str, ...]] = {
     "generation": ("generation", "best_score"),
     "parity": ("generation", "checked", "max_drift"),
@@ -158,6 +167,19 @@ METRIC_KIND_REQUIRED: Dict[str, Tuple[str, ...]] = {
     # exporter renders these as fks_serve_snapshot_cache_* gauges
     "snapshot_cache": ("hits", "misses", "entries", "hit_rate",
                        "h2d_bytes_per_query"),
+    # executable-footprint ledger (fks_tpu.obs.memory): one record per
+    # compiled executable — its memory_analysis() byte breakdown tagged
+    # with the compiling tier and mesh layout
+    "memory_footprint": ("component", "exe_key", "temp_bytes",
+                         "argument_bytes", "output_bytes",
+                         "generated_code_bytes"),
+    # watermark sampler (fks_tpu.obs.memory): host RSS + per-device
+    # normalized memory watermarks, per stage or per sampling interval
+    "memory_watermark": ("stage", "host_rss_kb", "devices"),
+    # leak sentinel (fks_tpu.obs.memory): live_arrays() drift across N
+    # iterations of a fenced hot loop, judged against a tolerance
+    "leak_check": ("loop", "iterations", "drift_count", "drift_bytes",
+                   "ok"),
 }
 
 #: an OpenMetrics sample line: name, optional {labels}, value, optional
@@ -249,6 +271,18 @@ def check_kinds(path: str, records: List[dict],
                 raise SchemaError(
                     f"{path}: record {i + 1}: unknown vm_swap outcome "
                     f"{out!r} (expect one of {sorted(VM_SWAP_OUTCOMES)})")
+        elif rec.get("kind") == "memory_footprint":
+            comp = rec.get("component")
+            if comp not in MEMORY_COMPONENTS:
+                raise SchemaError(
+                    f"{path}: record {i + 1}: unknown memory component "
+                    f"{comp!r} (expect one of {sorted(MEMORY_COMPONENTS)})")
+        elif rec.get("kind") == "leak_check":
+            loop = rec.get("loop")
+            if loop not in LEAK_LOOPS:
+                raise SchemaError(
+                    f"{path}: record {i + 1}: unknown leak_check loop "
+                    f"{loop!r} (expect one of {sorted(LEAK_LOOPS)})")
         elif rec.get("kind") == "decision_trace":
             _check_embedded_events(path, i, rec.get("events", []))
         elif rec.get("kind") == "trace_diff":

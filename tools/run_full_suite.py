@@ -80,6 +80,19 @@ promote TWICE through the live controller with zero XLA compiles on
 the serving process. A failure means the VM engine's program tables,
 the shared executables, or the zero-rebuild swap path regressed to
 recompiling. Recorded as ``vm_serve_gate``.
+
+A MEMORY GATE follows: the deterministic memory drills
+(fks_tpu.obs.memory) on an 8-virtual-device dryrun mesh —
+``cli mem --cpu --devices 8 --drill vm_swap_leak`` must show ZERO net
+``jax.live_arrays()`` growth across 50 swap_program promotions
+interleaved with 200 served batches (every swap frees the displaced
+program tables, every batch's buffers are donated or cache-hits), and
+``--drill snapshot_cache_bound`` must show the device snapshot cache
+holding a byte ceiling (evicts under pressure, never exceeds the cap,
+still re-hits recent entries). A failure means the serving tier is
+accreting device memory per promotion or the cache bound broke — the
+exact leak class that kills a long-lived serving process. Recorded as
+``memory_gate``.
 """
 from __future__ import annotations
 
@@ -301,6 +314,35 @@ def vm_serve_gate() -> dict:
     return {"ok": ok, **detail}
 
 
+def memory_gate() -> dict:
+    """Memory drills: ``cli mem --drill vm_swap_leak`` on an 8-device
+    dryrun mesh must show zero net ``jax.live_arrays()`` growth across
+    repeated swap+serve cycles, and ``--drill snapshot_cache_bound``
+    must show the snapshot cache evicting under a byte cap while still
+    re-hitting recent entries. Returns {"ok": bool, ...}."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    detail = {}
+    ok = True
+    steps = (
+        ("vm_swap_leak", [sys.executable, "-m", "fks_tpu.cli", "mem",
+                          "--cpu", "--devices", "8",
+                          "--drill", "vm_swap_leak"]),
+        ("snapshot_cache_bound", [sys.executable, "-m", "fks_tpu.cli",
+                                  "mem", "--cpu",
+                                  "--drill", "snapshot_cache_bound"]),
+    )
+    for name, cmd in steps:
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              cwd=REPO, env=env, timeout=900)
+        detail[f"{name}_rc"] = proc.returncode
+        if proc.returncode != 0:
+            ok = False
+            detail[f"{name}_err"] = (proc.stderr
+                                     or proc.stdout or "")[-500:]
+            break
+    return {"ok": ok, **detail}
+
+
 def _write_history(root: str, values) -> None:
     now = time.time()
     for i, v in enumerate(values):
@@ -380,6 +422,9 @@ def main() -> int:
     wgate = span_trace_gate()
     if not wgate["ok"]:
         print(f"SPAN TRACE GATE FAILED: {wgate}", file=sys.stderr)
+    ygate = memory_gate()
+    if not ygate["ok"]:
+        print(f"MEMORY GATE FAILED: {ygate}", file=sys.stderr)
     t0 = time.time()
     proc = subprocess.run(
         [sys.executable, "-m", "pytest", "tests/", "-q",
@@ -393,7 +438,7 @@ def main() -> int:
     gates_ok = (gate["ok"] and tgate["ok"] and sgate["ok"] and vgate["ok"]
                 and hgate["ok"] and lgate["ok"] and ngate["ok"]
                 and pgate["ok"] and rgate["ok"] and wgate["ok"]
-                and mgate["ok"])
+                and mgate["ok"] and ygate["ok"])
     rc = proc.returncode if gates_ok else (proc.returncode or 1)
     row = {"ts": round(time.time(), 1), "rev": rev, "rc": rc,
            "wall_s": wall, **counts, "obs_gate": gate,
@@ -401,7 +446,8 @@ def main() -> int:
            "sharded_serve_gate": hgate, "lint_gate": lgate,
            "trends_gate": ngate, "promote_gate": pgate,
            "resilience_gate": rgate, "span_trace_gate": wgate,
-           "vm_serve_gate": mgate, "summary": summary}
+           "vm_serve_gate": mgate, "memory_gate": ygate,
+           "summary": summary}
     os.makedirs(os.path.dirname(OUT), exist_ok=True)
     with open(OUT, "a") as f:
         f.write(json.dumps(row) + "\n")
