@@ -14,6 +14,7 @@ Usage:
     python -m fks_tpu.cli evolve [--config F] [--fake-llm] [--checkpoint F]
     python -m fks_tpu.cli scale [--nodes-count N] [--pods-count P] [--pop C]
     python -m fks_tpu.cli serve [--champion F] [--queries F | --http PORT]
+    python -m fks_tpu.cli loadgen [--tenants SPEC] [--duration S] [--http]
     python -m fks_tpu.cli report RUN_DIR
     python -m fks_tpu.cli export-metrics RUN_DIR [--out F]
     python -m fks_tpu.cli watch RUN_DIR [--interval S] [--once]
@@ -708,7 +709,8 @@ def cmd_serve(args):
                                audit_every=args.audit_every,
                                audit_tol=args.audit_tol, slo=slo,
                                max_queue=args.max_queue,
-                               default_deadline_s=args.request_deadline_s)
+                               default_deadline_s=args.request_deadline_s,
+                               accounting=args.accounting)
         if args.degraded_fallback:
             from fks_tpu.resilience import exact_fallback_factory
 
@@ -785,6 +787,95 @@ def cmd_serve(args):
             summary = service.summary()
             print(json.dumps(summary), file=sys.stderr)
     return 1 if errors else 0
+
+
+def cmd_loadgen(args):
+    """Drive a sustained multi-tenant arrival mix against a warm serve
+    service (fks_tpu.obs.workload.run_loadgen) and print the summary —
+    the four compare-gated keys ``loadgen_qps`` / ``loadgen_p99_ms`` /
+    ``loadgen_shed_rate`` / ``loadgen_fairness_index`` plus per-tenant
+    breakdowns. Accounting is always on: the run dir gets
+    ``tenant_stats`` / ``workload_mix`` / ``loadgen_summary`` records
+    alongside the serve metrics, so ``report`` / ``watch`` /
+    ``export-metrics`` render the tenant view afterwards. Default is a
+    hermetic template champion over a synthetic workload; ``--http``
+    routes through the concurrent localhost HTTP front instead of the
+    in-process client."""
+    _apply_platform_flags(args)
+    from fks_tpu import obs
+    from fks_tpu.obs.history import SLOConfig
+    from fks_tpu.obs.workload import (
+        http_client, parse_tenant_spec, run_loadgen, service_client,
+    )
+    from fks_tpu.serve import (
+        ChampionSpec, ServeEngine, ServeService, ShapeEnvelope,
+        load_champion, make_http_server,
+    )
+
+    try:
+        plan = parse_tenant_spec(args.tenants)
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    with _flight_recorder(args, "loadgen") as rec, obs.watch_compiles(rec):
+        if args.champion:
+            champion = load_champion(args.champion)
+            _, wl = _parse_workload(args)
+        else:
+            # hermetic default: a template champion over a synthetic
+            # workload, so loadgen runs before any evolution has
+            # produced a ledger (and repeat runs are bit-identical)
+            from fks_tpu.data.synthetic import synthetic_workload
+            from fks_tpu.funsearch import template
+
+            champion = ChampionSpec(
+                code=template.fill_template("score = 1000"),
+                source="<loadgen-default>")
+            wl = synthetic_workload(16, 32, seed=args.seed)
+        engine = ServeEngine(
+            champion, wl,
+            envelope=ShapeEnvelope(max_pods=args.max_pods,
+                                   max_batch=args.max_batch),
+            engine=args.engine, recorder=rec)
+        engine.warmup()  # measure serving, not first-call compiles
+        slo = (SLOConfig(p99_ms=args.slo_p99_ms) if args.slo_p99_ms
+               else None)
+        service = ServeService(engine, recorder=rec, slo=slo,
+                               max_queue=args.max_queue,
+                               accounting=True,
+                               workload_every=args.workload_every)
+        if rec.enabled:
+            rec.annotate_meta(tenants=args.tenants,
+                              duration_s=args.duration,
+                              front="http" if args.http is not None
+                              else "in-process")
+        server = None
+        try:
+            if args.http is not None:
+                import threading
+
+                server = make_http_server(service, args.http)
+                port = server.server_address[1]
+                threading.Thread(target=server.serve_forever,
+                                 daemon=True).start()
+                send = http_client(port)
+                print(f"loadgen -> http://127.0.0.1:{port}/query",
+                      file=sys.stderr)
+            else:
+                send = service_client(service)
+            summary = run_loadgen(send, plan, duration_s=args.duration,
+                                  seed=args.seed, recorder=rec)
+        finally:
+            if server is not None:
+                server.shutdown()
+                server.server_close()
+            service.close()
+            # record the serve-side view: tenant_stats / workload_mix /
+            # slo_burn rows land in the run dir even when the request
+            # count never crossed a workload_every window
+            service.summary()
+    print(json.dumps(summary, indent=2))
+    return 0
 
 
 def cmd_pipeline(args):
@@ -1570,7 +1661,55 @@ def main(argv=None) -> int:
                          "<ledger-dir>/promotion.jsonl)")
     sv.add_argument("--promote-interval", type=float, default=5.0,
                     help="seconds between ledger polls (default 5)")
+    sv.add_argument("--accounting", action="store_true",
+                    help="per-tenant accounting + query fingerprinting "
+                         "(fks_tpu.obs.workload): tenant_stats / "
+                         "workload_mix records in the run dir, "
+                         "fks_tenant_* gauges from export-metrics, a "
+                         "tenant table in 'report' (off by default — the "
+                         "disabled path costs nothing per request)")
     sv.set_defaults(fn=cmd_serve)
+
+    lg = sub.add_parser(
+        "loadgen",
+        help="drive a sustained multi-tenant arrival mix against a warm "
+             "serve service and print the gated loadgen summary",
+        parents=[common])
+    _add_trace_flags(lg)
+    lg.add_argument("--tenants", default="a:closed:2,b:closed:2,c:open:25",
+                    help="arrival plan, comma-separated "
+                         "name:mode:amount[:pods] — 'closed' amount = "
+                         "worker count (submit-wait-repeat), 'open' "
+                         "amount = Poisson qps (arrivals never wait on "
+                         "responses); pods = pods per query (default 2)")
+    lg.add_argument("--duration", type=float, default=5.0,
+                    help="seconds to sustain the arrival plan (default 5)")
+    lg.add_argument("--seed", type=int, default=0,
+                    help="loadgen RNG seed (open-loop arrival gaps; also "
+                         "the synthetic-workload seed)")
+    lg.add_argument("--champion", default="",
+                    help="champion JSON to serve (default: a hermetic "
+                         "built-in template champion over a synthetic "
+                         "workload)")
+    lg.add_argument("--http", type=int, nargs="?", const=0, default=None,
+                    help="route through the concurrent localhost HTTP "
+                         "front on this port (bare --http = ephemeral "
+                         "port) instead of the in-process client")
+    lg.add_argument("--max-pods", type=int, default=64,
+                    help="shape envelope: largest query (default 64)")
+    lg.add_argument("--max-batch", type=int, default=4,
+                    help="shape envelope: largest coalesced batch "
+                         "(default 4)")
+    lg.add_argument("--max-queue", type=int, default=0,
+                    help="bounded queue depth for admission-control "
+                         "shedding (0 = unbounded)")
+    lg.add_argument("--slo-p99-ms", type=float, default=50.0,
+                    help="per-tenant SLO p99 target feeding burn rates "
+                         "(default 50; 0 = unset)")
+    lg.add_argument("--workload-every", type=int, default=100,
+                    help="emit tenant_stats/workload_mix every N served "
+                         "requests (default 100)")
+    lg.set_defaults(fn=cmd_loadgen)
 
     pp = sub.add_parser(
         "pipeline", parents=[common],

@@ -34,6 +34,10 @@ jitted code.
                   baselines, SLO burn rates (``cli trends``)
 - ``memory``    — executable-footprint ledger, watermark sampler, leak
                   sentinel + drills (``cli mem``, ``fks_mem_*`` gauges)
+- ``workload``  — query fingerprinting, per-tenant accounting with SLO
+                  burn + fairness, and the multi-tenant load generator
+                  (``cli loadgen`` / ``bench --stage loadgen``,
+                  ``fks_tenant_*`` gauges)
 """
 from fks_tpu.obs.compare import (
     DEFAULT_THRESHOLDS, Threshold, compare_runs, extract_metrics,
@@ -76,24 +80,32 @@ from fks_tpu.obs.watchdog import (
     FLAG_INF, FLAG_NAN, FLAG_RANGE, ParitySentinel, check_result,
     combined_flags, describe_flags,
 )
+from fks_tpu.obs.workload import (
+    DEFAULT_TENANT, LOADGEN_MODES, QueryFingerprinter, TenantAccountant,
+    TenantLoad, default_make_pods, http_client, jain_fairness,
+    parse_tenant_spec, run_loadgen, service_client, tenant_of,
+)
 
 __all__ = [
-    "DEFAULT_THRESHOLDS", "FLAG_INF", "FLAG_NAN", "FLAG_RANGE",
-    "LEAK_LOOPS", "MEMORY_COMPONENTS", "NULL", "NULL_PROFILER",
-    "NULL_SAMPLER", "CompileWatcher", "EvolutionLedger", "FlightRecorder",
-    "FootprintLedger", "LeakSentinel", "NullRecorder", "ParitySentinel",
-    "RunHistory", "SLOConfig", "StageProfiler", "Threshold",
-    "WatermarkSampler", "align_traces", "candidate_trace_diff",
-    "check_result", "combined_flags", "compare_runs", "describe_flags",
-    "device_snapshot", "extract_metrics", "extract_trace",
-    "footprint_of", "format_comparison", "format_diff", "get_recorder",
-    "has_regression", "health_line", "leak_fence", "live_array_stats",
-    "mesh_snapshot", "normalize_memory_stats",
+    "DEFAULT_TENANT", "DEFAULT_THRESHOLDS", "FLAG_INF", "FLAG_NAN",
+    "FLAG_RANGE", "LEAK_LOOPS", "LOADGEN_MODES", "MEMORY_COMPONENTS",
+    "NULL", "NULL_PROFILER", "NULL_SAMPLER", "CompileWatcher",
+    "EvolutionLedger", "FlightRecorder", "FootprintLedger", "LeakSentinel",
+    "NullRecorder", "ParitySentinel", "QueryFingerprinter", "RunHistory",
+    "SLOConfig", "StageProfiler", "TenantAccountant", "TenantLoad",
+    "Threshold", "WatermarkSampler", "align_traces", "candidate_trace_diff",
+    "check_result", "combined_flags", "compare_runs", "default_make_pods",
+    "describe_flags", "device_snapshot", "extract_metrics",
+    "extract_trace", "footprint_of", "format_comparison", "format_diff",
+    "get_recorder", "has_regression", "health_line", "http_client",
+    "jain_fairness", "leak_fence", "live_array_stats", "mesh_snapshot",
+    "normalize_memory_stats", "parse_tenant_spec",
     "parse_threshold_overrides", "profile_launch", "record_devices",
     "record_footprint", "record_mesh", "record_slo_burn", "recording",
     "render_report", "resolve_auto_baseline", "rollup", "run_drill",
-    "run_health", "slo_burn", "span", "span_path", "sparkline",
-    "to_openmetrics", "trace_diff", "watch", "watch_compiles",
+    "run_health", "run_loadgen", "service_client", "slo_burn", "span",
+    "span_path", "sparkline", "tenant_of", "to_openmetrics", "trace_diff",
+    "watch", "watch_compiles",
     "TraceContext", "activate_trace", "critical_path", "current_trace",
     "emit_span", "new_trace", "render_waterfall", "trace_ctx",
 ]

@@ -100,6 +100,18 @@ DEFAULT_THRESHOLDS: Dict[str, Threshold] = {
     # more than 5 points — a drop means the analyzer stopped catching a
     # junk class it used to catch (absolute: the rate is already a ratio)
     "preflight_reject_rate": Threshold(higher_is_better=True, abs_tol=0.05),
+    # sustained multi-tenant load (bench stage_loadgen): throughput and
+    # the Jain fairness index over per-tenant goodput must not drop,
+    # tail latency and shed rate must not grow. qps/p99 get the serve
+    # treatment; shed rate and fairness are already ratios, so absolute
+    # tolerances (2 points of shed, 5 points of fairness) absorb
+    # scheduling jitter in short deterministic runs
+    "loadgen_qps": Threshold(higher_is_better=True, rel=0.10),
+    "loadgen_p99_ms": Threshold(higher_is_better=False, rel=0.25,
+                                abs_tol=2.0),
+    "loadgen_shed_rate": Threshold(higher_is_better=False, abs_tol=0.02),
+    "loadgen_fairness_index": Threshold(higher_is_better=True,
+                                        abs_tol=0.05),
 }
 
 
@@ -134,7 +146,8 @@ def _from_run_dir(run_dir: str) -> Dict[str, float]:
         for key in ("evals_per_sec", "code_evals_per_sec",
                     "budget_speedup", "budget_champion_match",
                     "scale1k_events_per_sec", "serve_qps",
-                    "serve_sharded_qps", "preflight_reject_rate"):
+                    "serve_sharded_qps", "preflight_reject_rate",
+                    "loadgen_qps", "loadgen_fairness_index"):
             v = _num(m.get(key))
             if v is not None:
                 out[key] = max(out.get(key, 0.0), v)
@@ -142,7 +155,8 @@ def _from_run_dir(run_dir: str) -> Dict[str, float]:
         # mirroring serve_qps's max
         for key in ("serve_p99_ms", "serve_h2d_bytes_per_query",
                     "trace_overhead_pct", "promotion_swap_ms",
-                    "vm_swap_h2d_bytes"):
+                    "vm_swap_h2d_bytes", "loadgen_p99_ms",
+                    "loadgen_shed_rate"):
             v = _num(m.get(key))
             if v is not None:
                 out[key] = min(out.get(key, v), v)
@@ -192,7 +206,8 @@ def _from_jsonl(path: str, allow_stale: bool = False) -> Dict[str, float]:
                     "serve_h2d_bytes_per_query", "preflight_reject_rate",
                     "trace_overhead_pct", "promotion_swap_ms",
                     "vm_swap_h2d_bytes", "peak_device_bytes",
-                    "exe_temp_bytes"):
+                    "exe_temp_bytes", "loadgen_qps", "loadgen_p99_ms",
+                    "loadgen_shed_rate", "loadgen_fairness_index"):
             v = _num(rec.get(key))
             if v is None:
                 continue
@@ -205,7 +220,8 @@ def _from_jsonl(path: str, allow_stale: bool = False) -> Dict[str, float]:
                 continue
             if key in ("compile_seconds", "serve_p99_ms",
                        "serve_h2d_bytes_per_query", "trace_overhead_pct",
-                       "promotion_swap_ms", "vm_swap_h2d_bytes"):
+                       "promotion_swap_ms", "vm_swap_h2d_bytes",
+                       "loadgen_p99_ms", "loadgen_shed_rate"):
                 out[key] = min(out.get(key, v), v)
             elif key in ("peak_device_bytes", "exe_temp_bytes"):
                 # peak metrics: the high-water mark across records

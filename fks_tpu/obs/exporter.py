@@ -234,6 +234,75 @@ def to_openmetrics(run_dir: str) -> str:
                 0 if s.get("engine_state") == "normal" else 1,
                 run_id=run_id, state=str(s.get("engine_state")))
 
+    # per-tenant accounting (fks_tpu.obs.workload.TenantAccountant):
+    # latest tenant_stats record per tenant — the fairness index is a
+    # GLOBAL value every row carries, exported once unlabeled
+    latest_tenant: Dict[str, dict] = {}
+    for t in (m for m in metrics if m.get("kind") == "tenant_stats"):
+        latest_tenant[str(t.get("tenant", "?"))] = t
+    for name in sorted(latest_tenant):
+        t = latest_tenant[name]
+        fam("tenant_requests_total", "gauge",
+            "requests completed for this tenant").add(
+            t.get("requests"), run_id=run_id, tenant=name)
+        fam("tenant_shed_total", "gauge",
+            "requests shed at admission for this tenant").add(
+            t.get("shed"), run_id=run_id, tenant=name)
+        fam("tenant_expired_total", "gauge",
+            "requests whose deadline expired while queued").add(
+            t.get("expired"), run_id=run_id, tenant=name)
+        fam("tenant_degraded_total", "gauge",
+            "requests answered on the degraded fallback engine").add(
+            t.get("degraded"), run_id=run_id, tenant=name)
+        fam("tenant_ewma_ms", "gauge",
+            "EWMA service time for this tenant (ms)").add(
+            t.get("ewma_ms"), run_id=run_id, tenant=name)
+        fam("tenant_p99_ms", "gauge",
+            "p99 latency for this tenant (ms)").add(
+            t.get("p99_ms"), run_id=run_id, tenant=name)
+        fam("tenant_goodput_qps", "gauge",
+            "completed requests per second for this tenant").add(
+            t.get("goodput_qps"), run_id=run_id, tenant=name)
+        fam("tenant_slo_burn_rate", "gauge",
+            "per-tenant p99 error-budget burn rate (>1 = violating)").add(
+            t.get("burn_rate"), run_id=run_id, tenant=name)
+    if latest_tenant:
+        any_row = latest_tenant[sorted(latest_tenant)[0]]
+        fam("tenant_fairness_index", "gauge",
+            "Jain's fairness index over per-tenant goodput "
+            "(1 = even, 1/n = one tenant has it all)").add(
+            any_row.get("fairness_index"), run_id=run_id)
+
+    # workload-class mix (fks_tpu.obs.workload.QueryFingerprinter):
+    # latest windowed distribution, one gauge per class
+    latest_mix = None
+    for m in (m for m in metrics if m.get("kind") == "workload_mix"):
+        latest_mix = m
+    if latest_mix is not None and isinstance(
+            latest_mix.get("classes"), dict):
+        for cls in sorted(latest_mix["classes"]):
+            fam("workload_class_requests", "gauge",
+                "requests in this workload class over the latest "
+                "fingerprint window").add(
+                latest_mix["classes"][cls], run_id=run_id,
+                workload_class=cls)
+
+    # loadgen summary (fks_tpu.obs.workload.run_loadgen): the latest
+    # generated-load verdict, the four compare-gated keys as gauges
+    latest_lg = None
+    for m in (m for m in metrics if m.get("kind") == "loadgen_summary"):
+        latest_lg = m
+    if latest_lg is not None:
+        m = latest_lg
+        for key, help_ in (
+                ("loadgen_qps", "sustained completed qps under load"),
+                ("loadgen_p99_ms", "p99 client-observed latency (ms)"),
+                ("loadgen_shed_rate", "fraction of requests shed"),
+                ("loadgen_fairness_index",
+                 "Jain fairness over per-tenant goodput under load")):
+            fam(key, "gauge", help_).add(
+                m.get(key), run_id=run_id, mode=m.get("mode"))
+
     # device-resident snapshot cache (ServeEngine content-hash ktable
     # cache): reuse vs upload economics of the sharded serve path
     latest_cache = None
@@ -507,6 +576,29 @@ def watch(run_dir: str, interval: float = 5.0, once: bool = False,
                 if rate > 1.0:
                     line = "SLO ALERT " + line
                 out.write(line + "\n")
+            elif kind == "tenant_stats":
+                rate = _num(m.get("burn_rate")) or 0.0
+                line = (f"tenant {m.get('tenant', '?')}: "
+                        f"{m.get('requests', 0)} req "
+                        f"p99 {m.get('p99_ms', 0.0)}ms "
+                        f"shed {m.get('shed', 0)} "
+                        f"burn {rate:.2f}x "
+                        f"fair {m.get('fairness_index', 1.0)}")
+                if rate > 1.0:
+                    line = "TENANT SLO ALERT " + line
+                out.write(line + "\n")
+            elif kind == "workload_mix":
+                classes = m.get("classes") or {}
+                top = sorted(classes.items(), key=lambda kv: -kv[1])[:3]
+                mix = " ".join(f"{c}={n}" for c, n in top)
+                out.write(f"workload mix ({m.get('window', 0)} req, "
+                          f"{m.get('distinct', 0)} classes): {mix}\n")
+            elif kind == "loadgen_summary":
+                out.write(f"loadgen [{m.get('mode', '?')}]: "
+                          f"{m.get('loadgen_qps', 0.0)} qps "
+                          f"p99 {m.get('loadgen_p99_ms', 0.0)}ms "
+                          f"shed {m.get('loadgen_shed_rate', 0.0)} "
+                          f"fair {m.get('loadgen_fairness_index', 1.0)}\n")
         h = run_health(run_dir, meta=meta, metrics=metrics)
         age = "-" if h["age"] is None else f"{h['age']:.0f}s"
         out.write(f"[{h['state']}] status={meta.get('status', '?')} "

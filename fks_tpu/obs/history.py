@@ -52,6 +52,8 @@ TREND_METRICS = (
     "evals_per_sec", "code_evals_per_sec", "compile_seconds",
     "best_score", "serve_p99_ms", "serve_qps", "scale1k_events_per_sec",
     "budget_speedup", "peak_device_bytes", "exe_temp_bytes",
+    "loadgen_qps", "loadgen_p99_ms", "loadgen_shed_rate",
+    "loadgen_fairness_index",
 )
 
 

@@ -356,6 +356,64 @@ def _memory_section(metrics: List[Dict[str, Any]]) -> List[str]:
     return lines
 
 
+def _tenant_section(metrics: List[Dict[str, Any]]) -> List[str]:
+    """Per-tenant accounting (fks_tpu.obs.workload): latest tenant_stats
+    row per tenant — request/shed/expired/degraded counters, EWMA and
+    tail latency, goodput, SLO burn — plus the Jain fairness index over
+    per-tenant goodput, the latest workload-mix window, and the last
+    loadgen summary when the run drove synthetic load."""
+    stats = [m for m in metrics if m.get("kind") == "tenant_stats"]
+    mixes = [m for m in metrics if m.get("kind") == "workload_mix"]
+    lgs = [m for m in metrics if m.get("kind") == "loadgen_summary"]
+    if not (stats or mixes or lgs):
+        return []
+    lines = ["tenants (obs.workload):"]
+    if stats:
+        latest: Dict[str, Dict[str, Any]] = {}
+        for m in stats:
+            latest[str(m.get("tenant", "?"))] = m
+        rows = [{
+            "tenant": t,
+            "req": m.get("requests", 0),
+            "shed": m.get("shed", 0),
+            "exp": m.get("expired", 0),
+            "deg": m.get("degraded", 0),
+            "ewma_ms": _num(float(m.get("ewma_ms", 0.0)), 2),
+            "p99_ms": _num(float(m.get("p99_ms", 0.0)), 2),
+            "qps": _num(float(m.get("goodput_qps", 0.0)), 2),
+            "burn": _num(float(m.get("burn_rate", 0.0)), 2),
+        } for t, m in sorted(latest.items())]
+        lines += _fmt_table(rows, ["tenant", "req", "shed", "exp", "deg",
+                                   "ewma_ms", "p99_ms", "qps", "burn"])
+        fair = float(next(iter(sorted(latest.items())))[1]
+                     .get("fairness_index", 1.0))
+        verdict = "ok" if fair >= 0.8 else "UNFAIR"
+        lines.append(f"  fairness index (Jain, goodput): "
+                     f"{fair:.4f} {verdict}")
+        violators = [t for t, m in sorted(latest.items())
+                     if float(m.get("burn_rate", 0.0)) > 1.0]
+        if violators:
+            lines.append("  SLO burn > 1x: " + ", ".join(violators))
+    if mixes:
+        m = mixes[-1]
+        classes = m.get("classes") or {}
+        top = sorted(classes.items(), key=lambda kv: -kv[1])[:5]
+        lines.append(
+            f"  workload mix: {m.get('distinct', 0)} classes over last "
+            f"{m.get('window', 0)} requests — "
+            + ", ".join(f"{c}={n}" for c, n in top))
+    for lg in lgs[-1:]:
+        lines.append(
+            f"  loadgen [{lg.get('mode', '?')}]: "
+            f"{lg.get('requests', 0)} requests, "
+            f"{_num(float(lg.get('loadgen_qps', 0.0)), 2)} qps, "
+            f"p99 {_num(float(lg.get('loadgen_p99_ms', 0.0)), 2)}ms, "
+            f"shed {100 * float(lg.get('loadgen_shed_rate', 0.0)):.1f}%, "
+            f"fairness "
+            f"{_num(float(lg.get('loadgen_fairness_index', 1.0)), 4)}")
+    return lines
+
+
 def _bench_section(metrics: List[Dict[str, Any]]) -> List[str]:
     stages = [m for m in metrics if m.get("kind") == "bench_stage"]
     lines = []
@@ -420,6 +478,7 @@ def render_report(run_dir: str) -> str:
     for section in (_infra_section(events), _generation_section(metrics),
                     _budget_section(metrics), _bench_section(metrics),
                     _device_profile_section(metrics), _slo_section(metrics),
+                    _tenant_section(metrics),
                     _memory_section(metrics), _compile_section(events),
                     _span_section(events)):
         if section:

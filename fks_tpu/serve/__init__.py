@@ -18,7 +18,7 @@ from fks_tpu.serve.batcher import (
     stack_queries, stack_query_tables, tree_h2d_bytes,
     unpack_program_tables, unpack_query_tables, validate_query_pods,
 )
-from fks_tpu.serve.service import ServeService, selftest
+from fks_tpu.serve.service import ServeService, make_http_server, selftest
 from fks_tpu.serve.vm_engine import VMServeEngine
 
 __all__ = [
@@ -29,5 +29,5 @@ __all__ = [
     "pods_to_dicts", "query_pack_plan", "stack_queries",
     "stack_query_tables", "tree_h2d_bytes", "unpack_program_tables",
     "unpack_query_tables", "validate_query_pods",
-    "ServeService", "selftest",
+    "ServeService", "make_http_server", "selftest",
 ]

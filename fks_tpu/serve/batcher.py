@@ -365,12 +365,17 @@ class RequestBatcher:
                  max_batch: int = 8, max_wait_s: float = 0.005,
                  max_queue: int = 0,
                  admission_cfg: Optional[AdmissionConfig] = None,
-                 recorder: Any = None):
+                 recorder: Any = None,
+                 expired_cb: Optional[Callable[[Any], None]] = None):
         from fks_tpu import obs
 
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
         self._handle = handle_batch
+        # accounting hook: called with the QUERY of every request whose
+        # deadline expired while queued (the service charges the tenant;
+        # the batcher knows futures, not tenants). Must not raise.
+        self._expired_cb = expired_cb
         self.max_batch = int(max_batch)
         self.max_wait_s = float(max_wait_s)
         cfg = admission_cfg or AdmissionConfig()
@@ -526,6 +531,11 @@ class RequestBatcher:
                         trace_id=r.trace_id)):
                     self.expired += 1
                     self.admission.note_expired()
+                    if self._expired_cb is not None:
+                        try:
+                            self._expired_cb(r.query)
+                        except Exception:  # noqa: BLE001 — accounting
+                            pass  # must never fail the drain/flush path
             else:
                 live.append(r)
         if not live:

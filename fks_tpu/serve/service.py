@@ -27,6 +27,9 @@ from fks_tpu import obs
 from fks_tpu.obs import trace_ctx
 from fks_tpu.obs.history import SLOConfig, record_slo_burn
 from fks_tpu.obs.watchdog import ParitySentinel
+from fks_tpu.obs.workload import (
+    QueryFingerprinter, TenantAccountant, tenant_of,
+)
 from fks_tpu.resilience.deadline import Deadline, ResilienceError
 from fks_tpu.resilience.degrade import DegradeConfig, DegradedModeManager
 from fks_tpu.serve.artifact import ChampionSpec, ServeEngine
@@ -48,10 +51,21 @@ class ServeService:
                  audit_every: int = 0, audit_tol: float = 1e-5,
                  slo: Optional[SLOConfig] = None, slo_every: int = 100,
                  replay_buffer: int = 64,
-                 max_queue: int = 0, default_deadline_s: float = 0.0):
+                 max_queue: int = 0, default_deadline_s: float = 0.0,
+                 accounting: bool = False, workload_every: int = 100):
         self.engine = engine
         self.recorder = recorder if recorder is not None else obs.get_recorder()
         self.audit_every = int(audit_every)
+        # tenant/workload accounting (obs.workload): OFF by default —
+        # the disabled path allocates nothing and touches no lock, the
+        # NullRecorder rule applied to accounting
+        self.accountant: Optional[TenantAccountant] = None
+        self.fingerprinter: Optional[QueryFingerprinter] = None
+        self.workload_every = max(1, int(workload_every))
+        self._wl_marks = 0
+        if accounting:
+            self.accountant = TenantAccountant(slo=slo)
+            self.fingerprinter = QueryFingerprinter()
         # resilience knobs: bounded queue + per-request deadline default
         # (a query's own deadline_ms always wins); 0 disables each
         self.default_deadline_s = float(default_deadline_s)
@@ -69,7 +83,7 @@ class ServeService:
             self._handle_batch,
             max_batch=max_batch or engine.envelope.max_batch,
             max_wait_s=max_wait_s, max_queue=max_queue,
-            recorder=self.recorder)
+            recorder=self.recorder, expired_cb=self._note_expired)
         self._seq = 0
         self._latencies_ms: List[float] = []
         self._t_first: Optional[float] = None
@@ -190,13 +204,26 @@ class ServeService:
         ``ShedError`` when admission control refuses the request (queue
         full / deadline unmeetable / draining)."""
         rid, pods = self.resolve_query(query)
+        tenant = tenant_of(query)
         deadline = Deadline.from_query(query, self.default_deadline_s)
         # every admitted request starts ONE causal trace; the context
         # object rides the queue to the batcher thread (null path: no
         # recorder -> no context is ever allocated)
         ctx = (trace_ctx.new_trace()
                if getattr(self.recorder, "enabled", False) else None)
-        return self._batcher.submit((rid, pods), deadline=deadline, ctx=ctx)
+        try:
+            return self._batcher.submit((rid, pods, tenant),
+                                        deadline=deadline, ctx=ctx)
+        except ResilienceError:
+            if self.accountant is not None:
+                self.accountant.note_shed(tenant)
+            raise
+
+    def _note_expired(self, item) -> None:
+        """Batcher callback: a request's deadline expired while queued —
+        charge the tenant (the batcher knows futures, not tenants)."""
+        if self.accountant is not None:
+            self.accountant.note_expired(item[2])
 
     def close(self) -> None:
         self._batcher.close()
@@ -228,7 +255,7 @@ class ServeService:
 
     # ----- batch handling (batcher thread)
 
-    def _handle_batch(self, items: List[Tuple[str, List[dict]]],
+    def _handle_batch(self, items: List[Tuple[str, List[dict], str]],
                       enq_times: List[float]) -> List[dict]:
         # pin the engine once per batch: the promotion controller may
         # swap ``self.engine`` concurrently, and a batch must be answered
@@ -237,7 +264,7 @@ class ServeService:
         t_start = time.perf_counter()
         fault: Optional[Tuple[BaseException, float]] = None
         try:
-            answers = engine.answer_batch([pods for _, pods in items])
+            answers = engine.answer_batch([pods for _, pods, _ in items])
         except Exception as e:  # noqa: BLE001 — maybe a device fault
             t_fail = time.perf_counter()
             if self._degrade is None or not self._degrade.on_fault(e):
@@ -247,7 +274,7 @@ class ServeService:
             # failed primary attempt stays on each request's trace
             fault = (e, t_fail - t_start)
             engine = self.engine
-            answers = engine.answer_batch([pods for _, pods in items])
+            answers = engine.answer_batch([pods for _, pods, _ in items])
         done = time.perf_counter()
         inflight = self._batcher.inflight()
         self._trace_batch(engine, inflight, t_start, done, fault)
@@ -255,7 +282,7 @@ class ServeService:
             self._t_first = min(enq_times)
         self._t_last = done
         occupancy = len(items) / self._batcher.max_batch
-        for i, ((rid, pods), enq, ans) in enumerate(
+        for i, ((rid, pods, tenant), enq, ans) in enumerate(
                 zip(items, enq_times, answers)):
             latency_ms = (done - enq) * 1e3
             ans["id"] = rid
@@ -265,13 +292,20 @@ class ServeService:
                 ans["trace_id"] = tid
             self._replay.append(pods)
             self._latencies_ms.append(latency_ms)
+            wl_class = ""
+            if self.fingerprinter is not None:
+                wl_class = self.fingerprinter.observe(pods)
+            if self.accountant is not None:
+                self.accountant.note_request(tenant, latency_ms,
+                                             degraded=fault is not None)
             self.recorder.metric(
-                "serve_request", request_id=rid,
+                "serve_request", request_id=rid, tenant=tenant,
                 latency_ms=round(latency_ms, 3), batch_size=len(items),
                 batch_occupancy=round(occupancy, 4),
                 bucket_pods=ans["bucket_pods"],
                 bucket_lanes=ans["bucket_lanes"],
-                **({"trace_id": tid} if tid else {}))
+                **({"trace_id": tid} if tid else {}),
+                **({"workload_class": wl_class} if wl_class else {}))
             if self.audit_every > 0 and \
                     len(self._latencies_ms) % self.audit_every == 0:
                 self._audit(engine, rid, pods, ans)
@@ -281,6 +315,13 @@ class ServeService:
             self._slo_marks = len(self._latencies_ms) // self.slo_every
             record_slo_burn(self.slo, self._latencies_ms,
                             self._elapsed(), recorder=self.recorder)
+        if (self.accountant is not None
+                and len(self._latencies_ms) // self.workload_every
+                > self._wl_marks):
+            self._wl_marks = len(self._latencies_ms) // self.workload_every
+            self.accountant.record(self.recorder)
+            if self.fingerprinter is not None:
+                self.fingerprinter.record_mix(self.recorder)
         if self._degrade is not None:
             self._degrade.after_batch(len(items))
         return answers
@@ -320,8 +361,12 @@ class ServeService:
             if ctx is None:
                 continue
             t_deq = min(max(r.t_deq, r.t_enq), t_start)
+            # tenant identity rides the root span as an attribute, so a
+            # waterfall (and any span query) can slice by tenant
+            tenant = r.query[2] if len(r.query) > 2 else ""
             trace_ctx.emit(rec, trace_ctx.SERVE_ROOT, done - r.t_enq,
-                           ctx=ctx, root=True, ts=_ts(done))
+                           ctx=ctx, root=True, ts=_ts(done),
+                           **({"tenant": tenant} if tenant else {}))
             trace_ctx.emit(rec, "serve/request/queue_wait",
                            t_deq - r.t_enq, ctx=ctx, ts=_ts(t_deq))
             trace_ctx.emit(rec, "serve/request/batch_wait",
@@ -396,10 +441,22 @@ class ServeService:
             out["slo"] = record_slo_burn(
                 self.slo, self._latencies_ms, elapsed,
                 recorder=self.recorder if record else obs.NULL)
+        if self.accountant is not None:
+            out["fairness_index"] = round(
+                self.accountant.fairness_index(), 4)
+            out["tenants"] = self.accountant.record(
+                self.recorder if record else None)
+            if self.fingerprinter is not None:
+                mix = self.fingerprinter.record_mix(
+                    self.recorder if record else None, reset=False)
+                if mix:
+                    out["workload_mix"] = mix
         if record:
             self.recorder.metric("serve", **{k: v for k, v in out.items()
                                              if k not in ("slo",
-                                                          "snapshot_cache")})
+                                                          "snapshot_cache",
+                                                          "tenants",
+                                                          "workload_mix")})
             if callable(cache_stats):
                 self.recorder.metric("snapshot_cache",
                                      **out["snapshot_cache"])
@@ -449,19 +506,24 @@ def run_jsonl(service: ServeService, stream_in=None, stream_out=None) -> int:
     return errors
 
 
-def run_http(service: ServeService, port: int, *, host: str = "127.0.0.1",
-             max_requests: Optional[int] = None,
-             deadline_s: float = 60.0,
-             drain_coordinator=None) -> None:
-    """Localhost-only HTTP front: POST /query (request JSON -> answer
-    JSON), GET /stats (service summary), GET /healthz (resilience view).
-    ``deadline_s`` bounds how long a POST waits on its Future (the old
-    hardcoded 60s); shed/expired/timed-out requests answer a STRUCTURED
-    503 with a Retry-After hint instead of a hung socket. A
-    ``DrainCoordinator`` (optional) gets the server-shutdown callback so
-    SIGTERM drains the batcher, persists state, then closes the listener.
-    ``max_requests`` stops the listener after N queries (test hook);
-    otherwise blocks until interrupted."""
+def make_http_server(service: ServeService, port: int = 0, *,
+                     host: str = "127.0.0.1",
+                     max_requests: Optional[int] = None,
+                     deadline_s: float = 60.0):
+    """Build (but do not run) the concurrent HTTP front: POST /query
+    (request JSON -> answer JSON), GET /stats (service summary), GET
+    /healthz (resilience view). The server is a ``ThreadingHTTPServer``
+    with DAEMON threads — each request is handled on its own thread, so
+    N clients genuinely overlap (two POSTs can sit in the SAME coalesced
+    batch; a single-threaded front would serialize them and every
+    measured qps number would be an artifact of the listener, not the
+    service) and a wedged keep-alive socket cannot block shutdown.
+    ``deadline_s`` bounds how long a POST waits on its Future;
+    shed/expired/timed-out requests answer a STRUCTURED 503 with a
+    Retry-After hint instead of a hung socket. ``port=0`` binds an
+    ephemeral port — read it back from ``server.server_address``.
+    ``max_requests`` stops the listener after N queries (test/loadgen
+    hook)."""
     import concurrent.futures as cf
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
@@ -517,7 +579,26 @@ def run_http(service: ServeService, port: int, *, host: str = "127.0.0.1",
         def log_message(self, *a):  # quiet: the recorder is the log
             pass
 
-    server = ThreadingHTTPServer((host, port), Handler)
+    class Server(ThreadingHTTPServer):
+        # per-request threads must not outlive the process: a client
+        # holding a socket open would otherwise block interpreter exit
+        daemon_threads = True
+
+    server = Server((host, port), Handler)
+    return server
+
+
+def run_http(service: ServeService, port: int, *, host: str = "127.0.0.1",
+             max_requests: Optional[int] = None,
+             deadline_s: float = 60.0,
+             drain_coordinator=None) -> None:
+    """Run the concurrent HTTP front (``make_http_server``) until
+    interrupted. A ``DrainCoordinator`` (optional) gets the
+    server-shutdown callback so SIGTERM drains the batcher, persists
+    state, then closes the listener."""
+    server = make_http_server(service, port, host=host,
+                              max_requests=max_requests,
+                              deadline_s=deadline_s)
     if drain_coordinator is not None:
         drain_coordinator.add_callback(
             lambda: __import__("threading").Thread(

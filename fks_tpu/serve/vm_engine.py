@@ -30,9 +30,11 @@ from __future__ import annotations
 
 import copy
 import dataclasses
+import hashlib
 import threading
 import time
 import warnings
+from collections import OrderedDict
 from typing import Optional
 
 import jax
@@ -80,6 +82,16 @@ class VMServeEngine(ServeEngine):
         self.vm_swaps = 0
         self.vm_swap_h2d_bytes = 0
         self.last_swap_breakdown: dict = {}
+        # host-side transpile cache: canonical code key -> padded
+        # VMProgram. The transpile is ~60ms of the 64ms swap
+        # (ROADMAP-named); a probation rollback or A/B flip re-swaps a
+        # champion this engine already lowered, so the warm swap is the
+        # H2D upload alone. Bounded FIFO — programs are a few KB.
+        self._transpile_cache: "OrderedDict[tuple, vm.VMProgram]" = \
+            OrderedDict()
+        self._transpile_cache_max = 32
+        self.transpile_cache_hits = 0
+        self.transpile_cache_misses = 0
         # swaps exclude in-flight batches: answer_batch holds this for
         # the whole batch, swap_program for the pointer flip only
         self._swap_lock = threading.RLock()
@@ -97,7 +109,38 @@ class VMServeEngine(ServeEngine):
         cap = self._capacity_override or vm.capacity_bucket(int(prog.n_ops))
         prog = vm.pad_capacity(prog, cap)  # VMUnsupported if too long
         self.program_capacity = cap
+        # seed the transpile cache: re-swapping the construction
+        # champion (rollback after a failed promotion) is a warm swap
+        self._transpile_cache[self._code_key(code, n, g, cap)] = prog
         return vm.score_static, prog, "vm"
+
+    @staticmethod
+    def _code_key(code: str, n: int, g: int, cap: int) -> tuple:
+        """Canonical transpile-cache key: exact content hash of the
+        champion source plus the lowering shape. NOT the analysis-layer
+        ``fingerprint`` — that one buckets constants by decade (dedup
+        semantics), which would alias two DIFFERENT champions onto one
+        cached program. A swap must serve exactly what was promoted."""
+        return (hashlib.sha256(code.encode()).hexdigest(), n, g, cap)
+
+    def _lower_champion(self, code: str, n: int, g: int) -> tuple:
+        """``compile_policy`` + ``pad_capacity`` through the host-side
+        cache; returns ``(prog, "hit"|"miss")``. ``VMUnsupported``
+        propagates uncached — a rejected champion must re-raise on
+        retry, not silently hit."""
+        key = self._code_key(code, n, g, self.program_capacity)
+        hit = self._transpile_cache.get(key)
+        if hit is not None:
+            self.transpile_cache_hits += 1
+            self._transpile_cache.move_to_end(key)
+            return hit, "hit"
+        prog = vm.pad_capacity(vm.compile_policy(code, n, g),
+                               self.program_capacity)
+        self.transpile_cache_misses += 1
+        self._transpile_cache[key] = prog
+        while len(self._transpile_cache) > self._transpile_cache_max:
+            self._transpile_cache.popitem(last=False)
+        return prog, "miss"
 
     def _upload_program(self, prog: vm.VMProgram):
         """Packed program tables -> device-resident pytree (replicated
@@ -122,8 +165,7 @@ class VMServeEngine(ServeEngine):
         handle; rolling back is another ``swap_program``."""
         t0 = time.perf_counter()
         n, g = self.cluster.n_padded, self.cluster.g_padded
-        prog = vm.compile_policy(champion.code, n, g)
-        prog = vm.pad_capacity(prog, self.program_capacity)
+        prog, cache = self._lower_champion(champion.code, n, g)
         t1 = time.perf_counter()
         dev = self._upload_program(prog)
         t2 = time.perf_counter()
@@ -141,6 +183,9 @@ class VMServeEngine(ServeEngine):
             "swap_ms": round((time.perf_counter() - t0) * 1e3, 3),
             "h2d_bytes": h2d,
             "capacity": self.program_capacity,
+            "transpile_cache": cache,
+            "transpile_cache_hits": self.transpile_cache_hits,
+            "transpile_cache_misses": self.transpile_cache_misses,
         }
         self.recorder.event("vm_swap", outcome="swapped",
                             champion=champion.source or "<inline>",
@@ -156,8 +201,9 @@ class VMServeEngine(ServeEngine):
         ``VMUnsupported`` propagates — the controller's AOT-fallback
         trigger."""
         n, g = self.cluster.n_padded, self.cluster.g_padded
-        prog = vm.pad_capacity(vm.compile_policy(champion.code, n, g),
-                               self.program_capacity)
+        # shares the incumbent's transpile cache too: promoting the
+        # champion just shadow-evaluated is then a warm swap
+        prog, _ = self._lower_champion(champion.code, n, g)
         shadow = copy.copy(self)
         shadow.champion = champion
         shadow.params = prog

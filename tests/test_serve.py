@@ -249,6 +249,154 @@ def test_service_answers_and_audits(engine):
         service.resolve_query({"nope": 1})
 
 
+def test_service_tenant_accounting(engine):
+    """accounting=True threads tenant identity end to end: the batcher
+    item carries it, serve_request rows are labelled, the accountant
+    aggregates per tenant, and every ``workload_every`` requests one
+    tenant_stats row per tenant plus a workload_mix row land on the
+    recorder."""
+    class Rec:
+        enabled = True
+
+        def __init__(self):
+            self.metrics, self.events = [], []
+
+        def metric(self, kind, record=None, **f):
+            self.metrics.append({"kind": kind, **(record or f)})
+
+        def event(self, kind, **f):
+            self.events.append((kind, f))
+
+    rec = Rec()
+    service = ServeService(engine, recorder=rec, max_wait_s=0.002,
+                           accounting=True, workload_every=2)
+    try:
+        futs = [service.submit({"id": f"q{i}", "tenant": t,
+                                "pods": _query(i, 2)})
+                for i, t in enumerate(("acme", "acme", "zoo"))]
+        for f in futs:
+            f.result(timeout=60)
+        summary = service.summary(record=False)
+    finally:
+        service.close()
+    stats = service.accountant.stats()
+    assert stats["acme"]["requests"] == 2 and stats["zoo"]["requests"] == 1
+    assert stats["acme"]["ewma_ms"] > 0
+    reqs = [m for m in rec.metrics if m["kind"] == "serve_request"]
+    assert [m["tenant"] for m in reqs] == ["acme", "acme", "zoo"]
+    assert all(m["workload_class"].startswith("p2:") for m in reqs)
+    # windowed accounting fired after crossing workload_every
+    tstats = [m for m in rec.metrics if m["kind"] == "tenant_stats"]
+    assert {m["tenant"] for m in tstats} == {"acme", "zoo"}
+    mixes = [m for m in rec.metrics if m["kind"] == "workload_mix"]
+    # the windowed record saw all 3 requests, then reset the window
+    assert mixes and mixes[0]["window"] == 3
+    assert 0.0 < summary["fairness_index"] <= 1.0
+    assert set(summary["tenants"]) == {"acme", "zoo"}
+
+
+def test_service_accounting_disabled_is_inert(engine):
+    """The disabled path allocates no accountant and labels rows with
+    the default tenant only — no workload_class field at all."""
+    class Rec:
+        enabled = False
+
+        def __init__(self):
+            self.metrics = []
+
+        def metric(self, kind, record=None, **f):
+            self.metrics.append({"kind": kind, **(record or f)})
+
+    rec = Rec()
+    service = ServeService(engine, recorder=rec, max_wait_s=0.002)
+    try:
+        service.submit({"pods": _query(0, 2)}).result(timeout=60)
+    finally:
+        service.close()
+    assert service.accountant is None and service.fingerprinter is None
+    row = [m for m in rec.metrics if m["kind"] == "serve_request"][0]
+    assert row["tenant"] == "default"
+    assert "workload_class" not in row
+
+
+# -------------------------------------------------------------- HTTP front
+
+
+def test_http_front_concurrent_clients_share_a_batch(engine):
+    """Two clients POSTing at once must land in ONE coalesced batch: with
+    max_batch=2 and a 5s flush wait, a serialized (single-threaded) front
+    would make each request wait out the full window alone — both
+    answering well under the window proves the handlers genuinely
+    overlap."""
+    import threading
+    import time
+
+    from fks_tpu.obs.workload import http_client
+    from fks_tpu.serve.service import make_http_server
+
+    service = ServeService(engine, max_batch=2, max_wait_s=5.0)
+    server = make_http_server(service, 0)
+    port = server.server_address[1]
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    send = http_client(port)
+    outcomes = [None, None]
+
+    def client(k):
+        outcomes[k] = send({"id": f"c{k}", "pods": _query(k, 2)})
+
+    try:
+        t0 = time.perf_counter()
+        c0 = threading.Thread(target=client, args=(0,))
+        c1 = threading.Thread(target=client, args=(1,))
+        c0.start()
+        c1.start()
+        c0.join(timeout=30)
+        c1.join(timeout=30)
+        elapsed = time.perf_counter() - t0
+    finally:
+        server.shutdown()
+        server.server_close()
+        service.close()
+    assert [o["outcome"] for o in outcomes] == ["ok", "ok"]
+    assert elapsed < 4.0, (
+        f"two concurrent POSTs took {elapsed:.1f}s — they waited out the "
+        "flush window instead of coalescing into one batch")
+    assert service.summary(record=False)["batches"] == 1
+
+
+def test_http_front_routes_and_errors(engine):
+    """GET /stats and /healthz answer; a malformed POST answers a
+    structured 400 instead of wedging the socket."""
+    import json as _json
+    import threading
+    import urllib.error
+    import urllib.request
+
+    from fks_tpu.serve.service import make_http_server
+
+    service = ServeService(engine, max_wait_s=0.002)
+    server = make_http_server(service, 0)
+    port = server.server_address[1]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    base = f"http://127.0.0.1:{port}"
+    try:
+        with urllib.request.urlopen(f"{base}/healthz", timeout=30) as r:
+            assert _json.loads(r.read())["ok"]
+        with urllib.request.urlopen(f"{base}/stats", timeout=30) as r:
+            assert "requests" in _json.loads(r.read())
+        bad = urllib.request.Request(
+            f"{base}/query", data=b'{"nope": 1}',
+            headers={"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(bad, timeout=30)
+        assert ei.value.code == 400
+    finally:
+        server.shutdown()
+        server.server_close()
+        service.close()
+
+
 def test_audit_served_alerts_on_drift():
     from fks_tpu.obs import ParitySentinel
 

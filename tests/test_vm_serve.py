@@ -240,6 +240,68 @@ def test_swap_program_returns_rollback_handle(wl, envelope):
     assert rolled[0]["placements"] == before[0]["placements"]
 
 
+def test_transpile_cache_makes_reswap_warm(wl, envelope):
+    """Host-side transpile cache (ISSUE-18): re-promoting a champion the
+    engine already lowered must skip ``compile_policy`` entirely — the
+    breakdown says "hit", the counters move, and the warm transpile leg
+    costs no more than the cold one. Keyed on the EXACT source hash, so
+    two different champions never alias; seeded at construction, so a
+    rollback to the original champion is warm from swap one."""
+    rec = RecStub()
+    eng = VMServeEngine(_champ(SEED_LOGIC, 0.4, source="<seed>"), wl,
+                        envelope=envelope, engine="flat", recorder=rec)
+    assert eng.transpile_cache_hits == 0
+    eng.swap_program(_champ(BETTER_LOGIC, 0.9, source="<new>"))
+    cold = dict(eng.last_swap_breakdown)
+    assert cold["transpile_cache"] == "miss"
+    assert cold["transpile_cache_misses"] == 1
+    # same source again (a rollback / A-B flip): pure cache lookup
+    eng.swap_program(_champ(BETTER_LOGIC, 0.9, source="<again>"))
+    warm = dict(eng.last_swap_breakdown)
+    assert warm["transpile_cache"] == "hit"
+    assert warm["transpile_cache_hits"] == 1
+    assert warm["transpile_ms"] <= cold["transpile_ms"]
+    # construction champion was seeded into the cache: rollback is warm
+    eng.swap_program(_champ(SEED_LOGIC, 0.4))
+    assert eng.last_swap_breakdown["transpile_cache"] == "hit"
+    swaps = [e for e in rec.events if e["kind"] == "vm_swap"]
+    assert [e["transpile_cache"] for e in swaps] == ["miss", "hit", "hit"]
+    # warm-swapped tables still serve exactly like a fresh build
+    eng.swap_program(_champ(BETTER_LOGIC, 0.9))
+    fresh = VMServeEngine(_champ(BETTER_LOGIC, 0.9), wl, envelope=envelope,
+                          engine="flat")
+    q = [_query(90)]
+    assert eng.answer_batch(q)[0]["score"] == \
+        fresh.answer_batch(q)[0]["score"]
+
+
+def test_transpile_cache_shared_with_shadow(wl, envelope):
+    """``shadow_for`` lowers through the incumbent's cache, so the
+    shadow-then-promote flow promotes WARM: the controller's real swap
+    is H2D only."""
+    eng = VMServeEngine(_champ(SEED_LOGIC, 0.4), wl, envelope=envelope,
+                        engine="flat")
+    cand = _champ(BETTER_LOGIC, 0.9, source="<cand>")
+    eng.shadow_for(cand)
+    eng.swap_program(cand)
+    assert eng.last_swap_breakdown["transpile_cache"] == "hit"
+
+
+def test_transpile_cache_never_caches_unsupported(wl, envelope):
+    """A VM-unlowerable champion must raise on EVERY attempt — a cached
+    rejection (or worse, a cached bogus program) would break the AOT
+    fallback's retry semantics."""
+    eng = VMServeEngine(_champ(SEED_LOGIC, 0.4), wl, envelope=envelope,
+                        engine="flat")
+    bad = _champ(UNSUPPORTED_LOGIC, 0.9)
+    misses_before = eng.transpile_cache_misses
+    for _ in range(2):
+        with pytest.raises(vm.VMUnsupported):
+            eng.swap_program(bad)
+    assert eng.transpile_cache_misses == misses_before
+    assert eng.transpile_cache_hits == 0
+
+
 def test_service_swap_engine_routes_championspec(wl, envelope):
     eng = VMServeEngine(_champ(SEED_LOGIC, 0.4, source="<old>"), wl,
                         envelope=envelope, engine="flat")

@@ -122,6 +122,10 @@ MEMORY_COMPONENTS = {"serve_aot", "serve_vm", "evolve", "bench"}
 #: .LEAK_LOOPS) — which hot loop the leak sentinel fenced
 LEAK_LOOPS = {"serve_batch", "vm_swap", "promotion", "evolve_generation",
               "drill"}
+#: legal ``mode`` values on a loadgen_summary record (duplicated from
+#: fks_tpu.obs.workload.LOADGEN_MODES; tests/test_workload.py pins the
+#: two copies) — the arrival process that produced the numbers
+LOADGEN_MODES = {"open", "closed", "mixed"}
 METRIC_KIND_REQUIRED: Dict[str, Tuple[str, ...]] = {
     "generation": ("generation", "best_score"),
     "parity": ("generation", "checked", "max_drift"),
@@ -180,6 +184,19 @@ METRIC_KIND_REQUIRED: Dict[str, Tuple[str, ...]] = {
     # iterations of a fenced hot loop, judged against a tolerance
     "leak_check": ("loop", "iterations", "drift_count", "drift_bytes",
                    "ok"),
+    # workload fingerprinting (fks_tpu.obs.workload): the windowed
+    # distribution of query classes the serve path observed
+    "workload_mix": ("window", "distinct", "classes"),
+    # per-tenant accounting (fks_tpu.obs.workload): one row per tenant —
+    # counters, latency, goodput, SLO burn, global fairness index
+    "tenant_stats": ("tenant", "requests", "shed", "expired", "ewma_ms",
+                     "p99_ms", "goodput_qps", "burn_rate",
+                     "fairness_index"),
+    # load generator (fks_tpu.obs.workload.run_loadgen): the sustained
+    # multi-tenant run summary carrying the four compare-gated keys
+    "loadgen_summary": ("mode", "requests", "loadgen_qps",
+                        "loadgen_p99_ms", "loadgen_shed_rate",
+                        "loadgen_fairness_index"),
 }
 
 #: an OpenMetrics sample line: name, optional {labels}, value, optional
@@ -283,6 +300,12 @@ def check_kinds(path: str, records: List[dict],
                 raise SchemaError(
                     f"{path}: record {i + 1}: unknown leak_check loop "
                     f"{loop!r} (expect one of {sorted(LEAK_LOOPS)})")
+        elif rec.get("kind") == "loadgen_summary":
+            mode = rec.get("mode")
+            if mode not in LOADGEN_MODES:
+                raise SchemaError(
+                    f"{path}: record {i + 1}: unknown loadgen mode "
+                    f"{mode!r} (expect one of {sorted(LOADGEN_MODES)})")
         elif rec.get("kind") == "decision_trace":
             _check_embedded_events(path, i, rec.get("events", []))
         elif rec.get("kind") == "trace_diff":

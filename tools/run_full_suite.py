@@ -93,6 +93,14 @@ still re-hits recent entries). A failure means the serving tier is
 accreting device memory per promotion or the cache bound broke — the
 exact leak class that kills a long-lived serving process. Recorded as
 ``memory_gate``.
+
+A LOADGEN GATE follows: a short deterministic two-tenant closed-loop
+run through the concurrent HTTP front (``bench.py --stage loadgen``)
+with per-tenant accounting on — shed rate must stay bounded, the Jain
+fairness index over tenant goodput must stay >= 0.8, and the steady
+state must serve with ZERO recompiles. A failure means the tenant
+accounting, the threaded HTTP front, or the warm serving path
+regressed under overlapping clients. Recorded as ``loadgen_gate``.
 """
 from __future__ import annotations
 
@@ -343,6 +351,29 @@ def memory_gate() -> dict:
     return {"ok": ok, **detail}
 
 
+def loadgen_gate() -> dict:
+    """Multi-tenant load generation: a short deterministic two-tenant
+    closed-loop run through the concurrent HTTP front
+    (``bench.py --stage loadgen``) must complete with a bounded shed
+    rate, a Jain fairness index at or above threshold, and ZERO
+    steady-state recompiles. A failure means the tenant accounting,
+    the concurrent front, or the warm serving path regressed under
+    overlapping clients. Returns {"ok": bool, ...}."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               FKS_BENCH_LOADGEN_S="2",
+               FKS_BENCH_LOADGEN_TENANTS="a:closed:2,b:closed:2",
+               FKS_BENCH_LOADGEN_FAIRNESS_MIN="0.8")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"),
+         "--stage", "loadgen"],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=900)
+    ok = proc.returncode == 0
+    detail = {"rc": proc.returncode}
+    if not ok:
+        detail["err"] = (proc.stderr or proc.stdout or "")[-500:]
+    return {"ok": ok, **detail}
+
+
 def _write_history(root: str, values) -> None:
     now = time.time()
     for i, v in enumerate(values):
@@ -425,6 +456,9 @@ def main() -> int:
     ygate = memory_gate()
     if not ygate["ok"]:
         print(f"MEMORY GATE FAILED: {ygate}", file=sys.stderr)
+    dgate = loadgen_gate()
+    if not dgate["ok"]:
+        print(f"LOADGEN GATE FAILED: {dgate}", file=sys.stderr)
     t0 = time.time()
     proc = subprocess.run(
         [sys.executable, "-m", "pytest", "tests/", "-q",
@@ -438,7 +472,7 @@ def main() -> int:
     gates_ok = (gate["ok"] and tgate["ok"] and sgate["ok"] and vgate["ok"]
                 and hgate["ok"] and lgate["ok"] and ngate["ok"]
                 and pgate["ok"] and rgate["ok"] and wgate["ok"]
-                and mgate["ok"] and ygate["ok"])
+                and mgate["ok"] and ygate["ok"] and dgate["ok"])
     rc = proc.returncode if gates_ok else (proc.returncode or 1)
     row = {"ts": round(time.time(), 1), "rev": rev, "rc": rc,
            "wall_s": wall, **counts, "obs_gate": gate,
@@ -447,7 +481,7 @@ def main() -> int:
            "trends_gate": ngate, "promote_gate": pgate,
            "resilience_gate": rgate, "span_trace_gate": wgate,
            "vm_serve_gate": mgate, "memory_gate": ygate,
-           "summary": summary}
+           "loadgen_gate": dgate, "summary": summary}
     os.makedirs(os.path.dirname(OUT), exist_ok=True)
     with open(OUT, "a") as f:
         f.write(json.dumps(row) + "\n")
