@@ -1988,6 +1988,108 @@ def stage_loadgen(gate: str = "") -> int:
     return rc
 
 
+def stage_layout(gate: str = "") -> int:
+    """CPU subprocess: measured layout sweep (fks_tpu.obs.layout) over
+    the virtual 8-device dryrun mesh — enumerate every valid
+    (candidate_shards x scenario_shards) layout of pop-64 x suite-8,
+    one warm probe each, and land the two gated keys:
+
+    - ``layout_best_over_default``: default-layout steady seconds over
+      the best measured layout's (>= 1.0; how much the best layout
+      beats the hard-coded default);
+    - ``layout_pad_waste_frac``: the best layout's padded-lane waste.
+
+    Plus ``layouts_probed`` (>= 3 required for the 8-device pop-64 x
+    suite-8 shape) and ``layout_parity_max_abs`` (every layout's robust
+    scores must match the default's within 1e-5 — a layout is a
+    schedule, never a different answer). Single-process CPU meshes
+    time-slice one host, so the ratio ranks layouts relatively;
+    absolute speedups need real devices (PROFILE.md round 22).
+
+    Env knobs: FKS_BENCH_LAYOUT_DEVICES (default 8), FKS_BENCH_LAYOUT_POP
+    (default 64), FKS_BENCH_LAYOUT_SUITE (default "default8"),
+    FKS_BENCH_LAYOUT_PARITY_MAX (default 1e-5).
+    """
+    devices = int(os.environ.get("FKS_BENCH_LAYOUT_DEVICES", "8"))
+    # must precede the first backend init; the env route works on every
+    # jax this repo supports (the stage runs in its own subprocess, so
+    # jax cannot have initialized yet)
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count"
+            f"={devices}").strip()
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    from fks_tpu.data.synthetic import synthetic_workload
+    from fks_tpu.obs.layout import explore_layouts
+    from fks_tpu.scenarios import get_suite
+
+    global _RECORDER
+    _RECORDER = _controller_recorder()
+    pop = int(os.environ.get("FKS_BENCH_LAYOUT_POP", "64"))
+    suite_name = os.environ.get("FKS_BENCH_LAYOUT_SUITE", "default8")
+    parity_max = float(os.environ.get("FKS_BENCH_LAYOUT_PARITY_MAX",
+                                      "1e-5"))
+    wl = synthetic_workload(16, 32, seed=0)
+    suite = get_suite(suite_name, wl)
+    history = None
+    try:
+        from fks_tpu.obs.history import RunHistory
+        root = os.environ.get("FKS_BENCH_RESULTS_DIR") or os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "benchmarks", "results")
+        if os.path.isdir(root):
+            history = RunHistory(root)
+    except Exception:  # noqa: BLE001 — the prior is best-effort
+        history = None
+    summary = explore_layouts(
+        suite, population=pop, engine="flat", recorder=_RECORDER,
+        history=history, workload_key=f"pop{pop}_{suite_name}")
+    log(f"layout stage: {summary['layouts_probed']} layouts over "
+        f"{summary['devices']} devices — best {summary['best_mesh_shape']}"
+        f" ({summary['best_layout_key']}) at "
+        f"{summary['best_steady_seconds']}s vs default "
+        f"{summary['default_steady_seconds']}s "
+        f"(ratio {summary['layout_best_over_default']}), parity "
+        f"{summary['parity_max_abs']}")
+    payload = {
+        "layouts_probed": summary["layouts_probed"],
+        "layout_best_over_default": summary["layout_best_over_default"],
+        "layout_pad_waste_frac": summary["layout_pad_waste_frac"],
+        "layout_parity_max_abs": summary["parity_max_abs"],
+        "layout_devices": summary["devices"],
+        "layout_candidates": summary["candidates"],
+        "layout_scenarios": summary["scenarios"],
+        "default_layout_key": summary["default_layout_key"],
+        "best_layout_key": summary["best_layout_key"],
+        "best_mesh_shape": summary["best_mesh_shape"],
+        "default_steady_seconds": summary["default_steady_seconds"],
+        "best_steady_seconds": summary["best_steady_seconds"],
+        "engine": "flat",
+    }
+    _record("metric", "bench_stage", payload, stage="layout",
+            platform="cpu")
+    rc = 0
+    if summary["layouts_probed"] < 3:
+        log(f"FAIL: only {summary['layouts_probed']} valid layouts "
+            f"probed for pop-{pop} x suite-{len(suite)} on "
+            f"{summary['devices']} devices (need >= 3)")
+        rc = 1
+    if summary["parity_max_abs"] > parity_max:
+        log(f"FAIL: layout parity {summary['parity_max_abs']} > "
+            f"{parity_max} — a layout changed the answer, not just "
+            "the schedule")
+        rc = 1
+    if gate:
+        rc = rc or _gate(gate, payload)
+    _record("finish", "ok" if rc == 0 else "fail")
+    _record("close")
+    print(json.dumps(payload))
+    return rc
+
+
 # ------------------------------------------------------------ controller
 
 
@@ -2104,6 +2206,11 @@ def main():
         # qps, tail latency, shed rate, fairness, zero steady-state
         # recompiles, accounting overhead); same --gate contract
         return stage_loadgen(gate)
+    if stage == "layout":
+        # standalone layout-sweep headline (valid layouts probed over
+        # the dryrun mesh, best-vs-default steady ratio, pad waste,
+        # robust-score parity); same --gate contract
+        return stage_layout(gate)
 
     # controller (hard deadline so the driver always gets the JSON line;
     # every stage/probe timeout below is clamped to the remaining budget)

@@ -1395,6 +1395,89 @@ def cmd_mem(args):
     return 0
 
 
+def cmd_layout(args):
+    """Layout observability (fks_tpu.obs.layout). Two modes:
+
+    - view (default): render the per-layout cost ledger of a recorded
+      run from ``--run-dir``'s JSONL alone — one row per
+      (workload_key, mesh_layout, layout_key) with pad waste, lane-step
+      occupancy, cost-analysis bytes, and the predicted HBM claim
+      joined from the footprint ledger;
+    - ``--explore``: enumerate the valid layouts of a (population x
+      suite) shape over the virtual CPU mesh (``--cpu --devices N``) or
+      the real devices, run one warm probe each, persist the best into
+      ``RunHistory``, and print the summary JSON. Exit 1 when the
+      CHOSEN layout (``--mesh-shape CxS``, default the candidates-only
+      default layout) is measurably dominated by another probe — the
+      scriptable seam run_full_suite's layout_gate leans on."""
+    if args.explore:
+        import os
+
+        _apply_platform_flags(args)
+        from fks_tpu.data.synthetic import synthetic_workload
+        from fks_tpu.obs import get_recorder
+        from fks_tpu.obs.layout import explore_layouts
+        from fks_tpu.scenarios import get_suite
+
+        wl = synthetic_workload(16, 32, seed=args.seed)
+        suite = get_suite(args.suite, wl)
+        wkey = f"pop{args.pop}_{args.suite}"
+        history = None
+        root = args.history_root or _default_history_root()
+        if os.path.isdir(root):
+            from fks_tpu.obs.history import RunHistory
+            history = RunHistory(root)
+        engine = args.engine if args.engine != "fused" else "flat"
+        with _flight_recorder(args, "layout"):
+            summary = explore_layouts(
+                suite, population=args.pop, engine=engine,
+                recorder=get_recorder(), history=history,
+                workload_key=wkey)
+        chosen = summary["default_layout_key"]
+        chosen_steady = summary["default_steady_seconds"]
+        if args.mesh_shape:
+            match = [p for p in summary["probes"]
+                     if p["mesh_shape"] == args.mesh_shape]
+            if not match:
+                shapes = [p["mesh_shape"] for p in summary["probes"]]
+                print(f"error: --mesh-shape {args.mesh_shape} not among "
+                      f"the valid layouts {shapes}", file=sys.stderr)
+                return 2
+            chosen = match[0]["layout_key"]
+            chosen_steady = match[0]["steady_seconds"]
+        best = summary["best_steady_seconds"]
+        dominated = (summary["best_layout_key"] != chosen
+                     and best > 0
+                     and chosen_steady / best > 1.05)
+        summary["chosen_layout_key"] = chosen
+        summary["chosen_dominated"] = dominated
+        print(json.dumps(summary, indent=2))
+        if dominated:
+            print(f"DOMINATED: chosen layout {chosen} is "
+                  f"{chosen_steady / best:.2f}x slower than "
+                  f"{summary['best_layout_key']}", file=sys.stderr)
+            return 1
+        return 0
+    if not args.run_dir:
+        print("error: layout needs --run-dir DIR (view mode) or "
+              "--explore", file=sys.stderr)
+        return 2
+    from fks_tpu.obs.report import _layout_section, load_run
+
+    try:
+        _meta, _events, metrics = load_run(args.run_dir)
+    except FileNotFoundError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    lines = _layout_section(metrics)
+    if not lines:
+        print(f"(no layout records in {args.run_dir} — ledger rows land "
+              "when a sharded entry point runs under --run-dir)")
+        return 0
+    print("\n".join(lines))
+    return 0
+
+
 def cmd_traces(args):
     """Dataset discovery (reference: parser.py:103-115)."""
     from fks_tpu.data import TraceParser
@@ -1914,6 +1997,34 @@ def main(argv=None) -> int:
                     help="with --cpu: size of the virtual CPU device "
                          "mesh the drill runs against")
     mm.set_defaults(fn=cmd_mem)
+
+    ly = sub.add_parser(
+        "layout",
+        help="layout observability: per-layout cost ledger view of a "
+             "run, or --explore to measure every valid layout of a "
+             "(population x suite x mesh) shape (exit 1 when the chosen "
+             "layout is measurably dominated)",
+        parents=[common])
+    ly.add_argument("--explore", action="store_true",
+                    help="enumerate + probe every valid layout and print "
+                         "the summary JSON (persists the best into "
+                         "RunHistory as a prior)")
+    ly.add_argument("--devices", type=int, default=0,
+                    help="with --cpu: size of the virtual CPU device "
+                         "mesh to explore over")
+    ly.add_argument("--pop", type=int, default=64,
+                    help="explore population size (default 64)")
+    ly.add_argument("--suite", default="default8",
+                    help="scenario suite to explore (default: default8)")
+    ly.add_argument("--mesh-shape", default="",
+                    help="the chosen CxS layout to defend (e.g. 4x2); "
+                         "default: the candidates-only default layout")
+    ly.add_argument("--seed", type=int, default=0,
+                    help="synthetic base-workload seed (default 0)")
+    ly.add_argument("--history-root", default="",
+                    help="RunHistory root for the layout prior (default: "
+                         "benchmarks/results)")
+    ly.set_defaults(fn=cmd_layout)
 
     t = sub.add_parser("traces", help="list available trace files")
     t.set_defaults(fn=cmd_traces)

@@ -112,6 +112,17 @@ DEFAULT_THRESHOLDS: Dict[str, Threshold] = {
     "loadgen_shed_rate": Threshold(higher_is_better=False, abs_tol=0.02),
     "loadgen_fairness_index": Threshold(higher_is_better=True,
                                         abs_tol=0.05),
+    # layout explorer (bench stage_layout): best-measured-over-default
+    # steady ratio must not drop more than 10 points (a drop means the
+    # default layout got relatively worse, or the explorer stopped
+    # finding the better layout it used to find), and the best layout's
+    # padded-lane waste must not grow more than 5 points — both are
+    # already ratios, so absolute tolerances absorb single-host
+    # time-slicing jitter on the dryrun mesh
+    "layout_best_over_default": Threshold(higher_is_better=True,
+                                          abs_tol=0.10),
+    "layout_pad_waste_frac": Threshold(higher_is_better=False,
+                                       abs_tol=0.05),
 }
 
 
@@ -147,7 +158,8 @@ def _from_run_dir(run_dir: str) -> Dict[str, float]:
                     "budget_speedup", "budget_champion_match",
                     "scale1k_events_per_sec", "serve_qps",
                     "serve_sharded_qps", "preflight_reject_rate",
-                    "loadgen_qps", "loadgen_fairness_index"):
+                    "loadgen_qps", "loadgen_fairness_index",
+                    "layout_best_over_default"):
             v = _num(m.get(key))
             if v is not None:
                 out[key] = max(out.get(key, 0.0), v)
@@ -156,7 +168,7 @@ def _from_run_dir(run_dir: str) -> Dict[str, float]:
         for key in ("serve_p99_ms", "serve_h2d_bytes_per_query",
                     "trace_overhead_pct", "promotion_swap_ms",
                     "vm_swap_h2d_bytes", "loadgen_p99_ms",
-                    "loadgen_shed_rate"):
+                    "loadgen_shed_rate", "layout_pad_waste_frac"):
             v = _num(m.get(key))
             if v is not None:
                 out[key] = min(out.get(key, v), v)
@@ -207,7 +219,8 @@ def _from_jsonl(path: str, allow_stale: bool = False) -> Dict[str, float]:
                     "trace_overhead_pct", "promotion_swap_ms",
                     "vm_swap_h2d_bytes", "peak_device_bytes",
                     "exe_temp_bytes", "loadgen_qps", "loadgen_p99_ms",
-                    "loadgen_shed_rate", "loadgen_fairness_index"):
+                    "loadgen_shed_rate", "loadgen_fairness_index",
+                    "layout_best_over_default", "layout_pad_waste_frac"):
             v = _num(rec.get(key))
             if v is None:
                 continue

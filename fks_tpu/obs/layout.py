@@ -1,0 +1,485 @@
+"""Layout observability (fks_tpu.obs.layout).
+
+The ROADMAP's parallelism-layout autotuner needs a priced search space
+before it can search: the mapping of the three batchable axes —
+candidates, scenarios, trace segments — onto the mesh was hard-coded
+inside ``parallel/mesh.py``, and the pad-waste / collective / occupancy
+costs of that choice were computed (``pad_stats``/``occupancy_stats``)
+but never attributed to a NAMED layout, persisted, or compared. This
+module makes layout a first-class, observable dimension:
+
+- ``LayoutSpec`` — a declarative, canonicalizable spec naming which
+  axes shard, which vmap, and the segment size (the FKS_VM_SEG_STEPS
+  contract). The default spec reproduces the historical hard-coded
+  behavior bit-identically (jaxpr-pinned in
+  ``tests/fixtures/jaxpr_pins.json``, same discipline as the memory
+  sampler's ``flat_step/mem_sampled`` pin).
+- ``LayoutLedger``/``record_layout`` — a bounded process-wide ledger of
+  per-layout cost rows: pad-waste per axis, occupancy, XLA
+  ``cost_analysis`` bytes (collective bytes when the backend exposes a
+  per-collective breakdown; total bytes-accessed otherwise), recorded
+  both at wiring time AND at eval time so remainder-padding changes
+  from dynamic population sizes show up. Identical repeat rows dedupe
+  by layout key — steady-state traffic costs one row, not one per call.
+- ``rollup_layouts`` — aggregation per (workload_key, mesh_layout,
+  layout_key), joining predicted HBM from the PR-17 footprint ledger
+  (``obs.memory.LEDGER``) by mesh layout.
+- ``explore_layouts`` — enumerate the valid (candidate_shards x
+  scenario_shards) factorizations of a device mesh for a given
+  (population x suite) shape, run each through one warm probe, emit
+  ``layout_probe`` metrics, and persist the best measured layout per
+  (workload_key, device_count) into ``RunHistory`` as a prior for the
+  future autotuner.
+
+Surfaces: ``cli layout`` (ledger table / ``--explore``),
+``fks_layout_*`` OpenMetrics gauges, a layout section in ``cli
+report``, and ``bench.py --stage layout`` whose best-vs-default ratio
+and pad-waste fraction are compare-gated keys.
+
+Import discipline: module level is stdlib + the recorder only; jax and
+``parallel.mesh`` load lazily inside functions (``parallel.mesh``
+imports this module the same way — no cycle at import time).
+"""
+from __future__ import annotations
+
+import dataclasses
+import re
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from fks_tpu.obs.recorder import get_recorder
+
+#: the three batchable axes a layout maps (closed vocabulary — mirrored
+#: stdlib-only in tools/check_jsonl_schema.py; keep in sync)
+LAYOUT_AXES = ("candidates", "scenarios", "segments")
+
+#: components that may file layout rows (closed vocabulary, mirrored in
+#: tools/check_jsonl_schema.py; keep in sync)
+LAYOUT_COMPONENTS = ("eval", "code_eval", "gen_step", "suite_eval",
+                     "serve", "vm_serve", "probe", "bench")
+
+_KEY_RE = re.compile(
+    r"^shard\[(?P<shard>[a-z_,]*)\]\|vmap\[(?P<vmap>[a-z_,]*)\]"
+    r"\|seg=(?P<seg>\d+)$")
+
+
+@dataclasses.dataclass(frozen=True)
+class LayoutSpec:
+    """Declarative layout: which axes shard over the mesh, which vmap
+    inside each shard, and the host-loop segment size.
+
+    Rules (validated at construction):
+
+    - every axis comes from the closed ``LAYOUT_AXES`` vocabulary;
+    - ``candidates`` always shards (it is the problem's primary parallel
+      dimension) and sharded axes stay locally vmapped inside their
+      shard, so ``shard`` is a subset of ``vmap``;
+    - ``segments`` never shards or vmaps — it is the segmented runner's
+      HOST loop, carried here only as ``seg_steps`` (the
+      FKS_VM_SEG_STEPS contract; 0 = single dispatch).
+    """
+
+    shard: Tuple[str, ...] = ("candidates",)
+    vmap: Tuple[str, ...] = ("candidates",)
+    seg_steps: int = 0
+
+    def __post_init__(self):
+        for field, axes in (("shard", self.shard), ("vmap", self.vmap)):
+            if not isinstance(axes, tuple):
+                object.__setattr__(self, field, tuple(axes))
+                axes = getattr(self, field)
+            for a in axes:
+                if a not in LAYOUT_AXES:
+                    raise ValueError(
+                        f"unknown layout axis {a!r} in {field}; choose "
+                        f"from {LAYOUT_AXES}")
+            if len(set(axes)) != len(axes):
+                raise ValueError(f"duplicate axes in {field}: {axes}")
+            if "segments" in axes:
+                raise ValueError(
+                    "'segments' is the segmented runner's host loop — it "
+                    f"cannot {field}; express it via seg_steps")
+        if "candidates" not in self.shard:
+            raise ValueError("'candidates' must shard (it is the only "
+                             "always-parallel axis)")
+        missing = set(self.shard) - set(self.vmap)
+        if missing:
+            raise ValueError(
+                f"sharded axes must stay locally vmapped inside their "
+                f"shard; {sorted(missing)} missing from vmap")
+        if self.seg_steps < 0:
+            raise ValueError(f"seg_steps {self.seg_steps} < 0")
+
+    @property
+    def key(self) -> str:
+        """Canonical key (round-trips through ``parse_layout_key``).
+        Axis ORDER is canonicalized to ``LAYOUT_AXES`` order, so two
+        specs naming the same axes in different orders share a key."""
+        shard = ",".join(a for a in LAYOUT_AXES if a in self.shard)
+        vmap = ",".join(a for a in LAYOUT_AXES if a in self.vmap)
+        return f"shard[{shard}]|vmap[{vmap}]|seg={self.seg_steps}"
+
+    def describe(self) -> dict:
+        return {"layout_key": self.key, "shard": list(self.shard),
+                "vmap": list(self.vmap), "seg_steps": self.seg_steps}
+
+
+def default_spec(*, scenarios: bool = False, seg_steps: int = 0
+                 ) -> LayoutSpec:
+    """The spec the hard-coded behavior always used: candidates shard
+    over the mesh's pop axes, everything batchable vmaps inside the
+    shard, segments stay a host loop. ``scenarios=True`` is the suite
+    entry point's default (candidates x scenarios vmap lanes)."""
+    vmap = ("candidates", "scenarios") if scenarios else ("candidates",)
+    return LayoutSpec(shard=("candidates",), vmap=vmap,
+                      seg_steps=int(seg_steps))
+
+
+def parse_layout_key(key: str) -> LayoutSpec:
+    """Inverse of ``LayoutSpec.key`` (raises ValueError on malformed or
+    out-of-vocabulary keys — the schema checker's contract)."""
+    m = _KEY_RE.match(key)
+    if not m:
+        raise ValueError(f"malformed layout key {key!r}")
+    split = lambda s: tuple(a for a in s.split(",") if a)  # noqa: E731
+    return LayoutSpec(shard=split(m.group("shard")),
+                      vmap=split(m.group("vmap")),
+                      seg_steps=int(m.group("seg")))
+
+
+def tag_layout(fn, layout_key: str):
+    """Best-effort tag of a compiled/compilable callable with its layout
+    key (``_fks_layout_key``). Transform-returned callables may reject
+    attribute assignment; the ledger row is the durable record, the tag
+    is a convenience for downstream components (serve engines read it
+    back into their footprint records)."""
+    try:
+        fn._fks_layout_key = layout_key
+    except (AttributeError, TypeError):
+        pass
+    return fn
+
+
+# ---------------------------------------------------------------- ledger
+
+
+class LayoutLedger:
+    """Bounded, thread-safe, process-wide ledger of layout cost rows.
+
+    ``add`` dedupes: a row identical to the LAST row filed under the
+    same (component, layout_key, mesh_layout, workload_key) is dropped —
+    steady-state eval loops re-recording unchanged pad stats cost one
+    row, while a CHANGED population size (different padding) lands a
+    fresh row."""
+
+    def __init__(self, cap: int = 512):
+        self.cap = int(cap)
+        self._rows: List[Dict[str, Any]] = []
+        self._last: Dict[Tuple, Dict[str, Any]] = {}
+        self._lock = threading.Lock()
+
+    def add(self, rec: Dict[str, Any]) -> bool:
+        key = (rec.get("component"), rec.get("layout_key"),
+               rec.get("mesh_layout"), rec.get("workload_key"))
+        with self._lock:
+            if self._last.get(key) == rec:
+                return False
+            self._last[key] = dict(rec)
+            self._rows.append(dict(rec))
+            if len(self._rows) > self.cap:
+                del self._rows[: len(self._rows) - self.cap]
+        return True
+
+    def records(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return [dict(r) for r in self._rows]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._rows.clear()
+            self._last.clear()
+
+
+#: the process-wide layout ledger (mirrors obs.memory.LEDGER)
+LEDGER = LayoutLedger()
+
+
+def cost_stats_of(compiled) -> dict:
+    """Best-effort XLA ``cost_analysis`` summary for a compiled
+    executable: ``cost_flops``/``cost_bytes_accessed`` totals plus
+    ``collective_bytes`` when the backend publishes a per-collective
+    breakdown (TPU backends do; CPU reports totals only, so the field
+    is honestly absent there). ``{}`` when the analysis is unavailable
+    — never an error."""
+    try:
+        cost = compiled.cost_analysis()
+    except Exception:  # noqa: BLE001 — estimates are best-effort
+        return {}
+    if isinstance(cost, (list, tuple)):
+        cost = cost[0] if cost else {}
+    if not isinstance(cost, dict):
+        return {}
+    out = {}
+    for key, name in (("flops", "cost_flops"),
+                      ("bytes accessed", "cost_bytes_accessed")):
+        v = cost.get(key)
+        if v is not None:
+            out[name] = float(v)
+    coll = sum(float(v) for k, v in cost.items()
+               if isinstance(v, (int, float)) and "collective" in k)
+    if coll:
+        out["collective_bytes"] = coll
+    return out
+
+
+def record_layout(component: str, spec, *, mesh=None, workload_key: str = "",
+                  real_count: Optional[int] = None, scenarios: int = 1,
+                  segments: int = 1, compiled=None, recorder=None,
+                  **fields) -> Optional[dict]:
+    """File one ``layout_ledger`` row in the process ledger and the
+    active recorder. ``spec`` is a ``LayoutSpec`` or a canonical key
+    string. With ``real_count`` and a mesh, pad/occupancy stats over the
+    mesh's candidate shards are folded in (the EVAL-TIME path — call it
+    per launch; the ledger dedupes identical repeats). ``compiled`` adds
+    the executable's ``cost_analysis`` bytes. Returns the row, or None
+    when it deduped away."""
+    if component not in LAYOUT_COMPONENTS:
+        raise ValueError(f"unknown layout component {component!r}; "
+                         f"choose from {LAYOUT_COMPONENTS}")
+    if isinstance(spec, str):
+        spec = parse_layout_key(spec)
+    from fks_tpu.obs.memory import mesh_layout_label
+    rec: Dict[str, Any] = {
+        "component": component,
+        "layout_key": spec.key,
+        "mesh_layout": mesh_layout_label(mesh),
+        "workload_key": workload_key,
+        "axes": [a for a in LAYOUT_AXES if a in spec.shard],
+        "seg_steps": spec.seg_steps,
+    }
+    if real_count is not None:
+        rec["real_count"] = int(real_count)
+        if mesh is not None:
+            from fks_tpu.parallel.mesh import num_shards, occupancy_stats
+            rec.update(occupancy_stats(int(real_count), num_shards(mesh),
+                                       scenarios=scenarios,
+                                       segments=segments))
+    if compiled is not None:
+        rec.update(cost_stats_of(compiled))
+    rec.update(fields)
+    if not LEDGER.add(rec):
+        return None
+    r = recorder if recorder is not None else get_recorder()
+    r.metric("layout_ledger", dict(rec))
+    return rec
+
+
+def rollup_layouts(records: Optional[Sequence[dict]] = None,
+                   footprints: Optional[Sequence[dict]] = None
+                   ) -> List[dict]:
+    """Aggregate ledger rows per (workload_key, mesh_layout, layout_key):
+    row counts, the latest pad/occupancy accounting, worst pad-waste
+    seen, summed lane-step occupancy, best-effort cost bytes, and the
+    predicted HBM claim joined from the footprint ledger
+    (``obs.memory.LEDGER``) by mesh layout — the largest executable
+    filed under that layout's mesh shape."""
+    if records is None:
+        records = LEDGER.records()
+    if footprints is None:
+        from fks_tpu.obs.memory import LEDGER as MEM_LEDGER
+        footprints = MEM_LEDGER.records()
+    hbm: Dict[str, int] = {}
+    for f in footprints:
+        ml = f.get("mesh_layout", "")
+        hbm[ml] = max(hbm.get(ml, 0), int(f.get("total_bytes", 0)))
+    groups: Dict[Tuple[str, str, str], List[dict]] = {}
+    for r in records:
+        k = (r.get("workload_key", ""), r.get("mesh_layout", ""),
+             r.get("layout_key", ""))
+        groups.setdefault(k, []).append(r)
+    out = []
+    for (wk, ml, lk), rows in sorted(groups.items()):
+        launched = sum(int(r.get("launched_lane_steps", 0)) for r in rows)
+        real = sum(int(r.get("real_lane_steps", 0)) for r in rows)
+        padded = [r for r in rows if "pad_waste_fraction" in r]
+        agg: Dict[str, Any] = {
+            "workload_key": wk, "mesh_layout": ml, "layout_key": lk,
+            "rows": len(rows),
+            "components": sorted({r.get("component", "") for r in rows}),
+            "pad_waste_fraction_max": max(
+                (float(r["pad_waste_fraction"]) for r in padded),
+                default=0.0),
+            "occupancy": (real / launched) if launched else 1.0,
+        }
+        if padded:
+            last = padded[-1]
+            agg["real_count"] = int(last.get("real_count", 0))
+            agg["padded_count"] = int(last.get("padded_count", 0))
+        costs = [r for r in rows if "cost_bytes_accessed" in r]
+        if costs:
+            agg["cost_bytes_accessed"] = max(
+                float(r["cost_bytes_accessed"]) for r in costs)
+        colls = [r for r in rows if "collective_bytes" in r]
+        if colls:
+            agg["collective_bytes"] = max(
+                float(r["collective_bytes"]) for r in colls)
+        if ml in hbm:
+            agg["predicted_hbm_bytes"] = hbm[ml]
+        steadies = [float(r["steady_seconds"]) for r in rows
+                    if "steady_seconds" in r]
+        if steadies:
+            agg["steady_seconds"] = min(steadies)
+        compiles = [float(r["compile_seconds"]) for r in rows
+                    if "compile_seconds" in r]
+        if compiles:
+            agg["compile_seconds"] = max(compiles)
+        out.append(agg)
+    return out
+
+
+# -------------------------------------------------------------- explorer
+
+
+def valid_layouts(num_devices: int, scenarios: int) -> List[dict]:
+    """Enumerate the valid (candidate_shards x scenario_shards)
+    factorizations of ``num_devices`` for a suite of ``scenarios``:
+    every factor pair c*s == num_devices with s dividing the scenario
+    count (the scenario axis shards without padding — scenario suites
+    are small and authored, unlike populations, so remainder-padding
+    them would silently skew the robust aggregate). s=1 is the default
+    layout and always valid; ordering is s ascending, so the default
+    comes first."""
+    num_devices = int(num_devices)
+    scenarios = int(scenarios)
+    if num_devices < 1:
+        raise ValueError(f"num_devices {num_devices} < 1")
+    out = []
+    for s in range(1, num_devices + 1):
+        if num_devices % s:
+            continue
+        if s > 1 and (scenarios < s or scenarios % s):
+            continue
+        spec = (default_spec(scenarios=True) if s == 1 else
+                LayoutSpec(shard=("candidates", "scenarios"),
+                           vmap=("candidates", "scenarios")))
+        out.append({"candidate_shards": num_devices // s,
+                    "scenario_shards": s,
+                    "mesh_shape": f"{num_devices // s}x{s}",
+                    "spec": spec})
+    return out
+
+
+def explore_layouts(suite, *, devices=None, population: int = 64,
+                    cfg=None, rc=None, elite_k: int = 8,
+                    engine: str = "exact", recorder=None, history=None,
+                    workload_key: str = "", reps: int = 2,
+                    dominance_margin: float = 0.05) -> dict:
+    """Measure every valid layout of (population x suite) over the given
+    devices: one wiring + one cold call (compile) + ``reps`` warm calls
+    per layout, a ``layout_probe`` metric each, parity of the robust
+    scores against the default layout, and a summary with the two
+    compare-gated keys (``layout_best_over_default``,
+    ``layout_pad_waste_frac``). With ``history`` (a ``RunHistory``) the
+    best measured layout persists per (workload_key, device_count) for
+    read-back as a prior. Single-process CPU dryrun meshes time-slice
+    one host, so steady-seconds deltas there rank layouts relatively;
+    absolute speedups need real devices (PROFILE.md round 22)."""
+    import jax
+    import numpy as np
+
+    from fks_tpu.models import parametric
+    from fks_tpu.parallel.mesh import (
+        layout_mesh, num_shards, pad_population, pad_stats,
+    )
+    from fks_tpu.scenarios.robust import RobustConfig, make_sharded_suite_eval
+    from fks_tpu.sim.engine import SimConfig
+
+    cfg = cfg if cfg is not None else SimConfig()
+    rc = rc if rc is not None else RobustConfig()
+    devices = list(devices) if devices is not None else list(jax.devices())
+    ndev = len(devices)
+    scn = len(suite)
+    rec = recorder if recorder is not None else get_recorder()
+    candidates = valid_layouts(ndev, scn)
+    params = parametric.init_population(jax.random.PRNGKey(0),
+                                        int(population))
+    probes: List[dict] = []
+    default_probe: Optional[dict] = None
+    default_robust: Optional[Any] = None
+    for lay in candidates:
+        spec = lay["spec"]
+        mesh = layout_mesh(devices, lay["scenario_shards"])
+        ev = make_sharded_suite_eval(suite, mesh, cfg=cfg, rc=rc,
+                                     elite_k=elite_k, engine=engine,
+                                     layout=spec)
+        padded, real = pad_population(params, num_shards(mesh))
+        t0 = time.perf_counter()
+        out = ev(padded, real)
+        jax.block_until_ready(out)
+        first = time.perf_counter() - t0
+        steady = float("inf")
+        for _ in range(max(1, int(reps))):
+            t0 = time.perf_counter()
+            out = ev(padded, real)
+            jax.block_until_ready(out)
+            steady = min(steady, time.perf_counter() - t0)
+        robust = np.asarray(out[0])[:real]
+        ps = pad_stats(real, num_shards(mesh))
+        if default_probe is None:  # s=1 enumerates first
+            parity = 0.0
+            default_robust = robust
+        else:
+            parity = float(np.max(np.abs(robust - default_robust)))
+        probe = {
+            "layout_key": spec.key,
+            "mesh_shape": lay["mesh_shape"],
+            "workload_key": workload_key,
+            "axes": [a for a in LAYOUT_AXES if a in spec.shard],
+            "candidates": int(real),
+            "scenarios": scn,
+            "candidate_shards": lay["candidate_shards"],
+            "scenario_shards": lay["scenario_shards"],
+            "first_call_seconds": round(first, 4),
+            "steady_seconds": round(steady, 6),
+            "pad_waste_fraction": ps["pad_waste_fraction"],
+            "parity_max_abs": parity,
+        }
+        rec.metric("layout_probe", dict(probe))
+        record_layout("probe", spec, mesh=mesh, workload_key=workload_key,
+                      real_count=real, scenarios=scn, recorder=rec,
+                      steady_seconds=probe["steady_seconds"],
+                      compile_seconds=round(max(0.0, first - steady), 4))
+        probes.append(probe)
+        if default_probe is None:
+            default_probe = probe
+    best = min(probes, key=lambda p: p["steady_seconds"])
+    ratio = (default_probe["steady_seconds"] / best["steady_seconds"]
+             if best["steady_seconds"] else 1.0)
+    summary = {
+        "workload_key": workload_key,
+        "devices": ndev,
+        "candidates": int(default_probe["candidates"]),
+        "scenarios": scn,
+        "layouts_probed": len(probes),
+        "default_layout_key": default_probe["layout_key"],
+        "best_layout_key": best["layout_key"],
+        "best_mesh_shape": best["mesh_shape"],
+        "default_steady_seconds": default_probe["steady_seconds"],
+        "best_steady_seconds": best["steady_seconds"],
+        "layout_best_over_default": round(ratio, 4),
+        "layout_pad_waste_frac": best["pad_waste_fraction"],
+        "parity_max_abs": max(p["parity_max_abs"] for p in probes),
+        "default_dominated": (best["layout_key"]
+                              != default_probe["layout_key"]
+                              and ratio > 1.0 + dominance_margin),
+        "probes": probes,
+    }
+    if history is not None:
+        history.record_layout_prior(
+            workload_key, str(ndev), best["layout_key"],
+            {"mesh_shape": best["mesh_shape"],
+             "steady_seconds": best["steady_seconds"],
+             "layout_best_over_default": summary["layout_best_over_default"],
+             "layout_pad_waste_frac": summary["layout_pad_waste_frac"]})
+    return summary

@@ -538,6 +538,9 @@ class ServeEngine:
                 fn = self._make_serve_fn(pod_bucket)
                 if self.mesh is not None:
                     fn = make_sharded_serve_fn(fn, self.mesh)
+                from fks_tpu.obs.layout import default_spec
+                self._layout_key = getattr(fn, "_fks_layout_key",
+                                           default_spec().key)
                 example = self._example_batch(lanes, pod_bucket)
                 with warnings.catch_warnings():
                     # buckets whose SimResult cannot alias a donated
@@ -554,7 +557,8 @@ class ServeEngine:
         record_footprint("serve_aot", f"lanes={lanes},pods={pod_bucket}",
                          compiled, mesh=self.mesh, recorder=self.recorder,
                          engine=self.engine_name,
-                         engine_kind=self.engine_kind)
+                         engine_kind=self.engine_kind,
+                         layout_key=self._layout_key)
         return compiled
 
     def warmup(self, lane_buckets: Optional[Sequence[int]] = None,
@@ -704,6 +708,14 @@ class ServeEngine:
             hs.sync(res.policy_score)
         res = jax.device_get(res)
         self.last_batch_timing["dispatch_s"] += time.perf_counter() - t0
+        # eval-time layout ledger row: per-batch occupancy attributed to
+        # the serve layout key (deduped by the ledger across equal rows)
+        from fks_tpu.obs.layout import record_layout
+        record_layout("vm_serve" if self.engine_kind == "vm" else "serve",
+                      getattr(self, "_layout_key", None) or
+                      "shard[candidates]|vmap[candidates]|seg=0",
+                      mesh=self.mesh, recorder=self.recorder,
+                      **occupancy_stats(real, lanes))
         for lane, i in enumerate(idxs):
             answers[i] = self._extract(res, lane, len(pod_lists[i]),
                                        bucket, lanes)

@@ -1,0 +1,404 @@
+"""Layout observability layer (fks_tpu.obs.layout).
+
+The ISSUE-19 acceptance criteria, as tests:
+
+- ``LayoutSpec``: canonical keys (axis order normalized), round-trips
+  through ``parse_layout_key``, and the validation rules — axes come
+  from the closed vocabulary, candidates always shard, sharded axes
+  stay vmapped, segments never shard (they are the host loop);
+- default-spec bit-identity: ``layout=None`` and an explicit
+  ``default_spec()`` lower to the SAME jaxpr on both sharded entry
+  points (the in-process twin of the ``sharded_eval/default_layout``
+  pin in tests/fixtures/jaxpr_pins.json);
+- ``LayoutLedger``: dedupe of identical consecutive rows per
+  (component, layout_key, mesh_layout, workload_key), changed rows
+  kept, cap trimming;
+- ``rollup_layouts``: occupancy from summed lane-steps, worst
+  pad-waste, best steady / worst compile seconds, and the predicted
+  HBM join from footprint rows by mesh layout;
+- ``valid_layouts`` enumeration math and the s=1-first ordering;
+- ``explore_layouts`` over the conftest 8-device mesh: every probe's
+  robust vector matches the default layout (parity), the summary
+  carries the compare-gated keys, and the best layout persists into
+  ``RunHistory`` for prior read-back;
+- closed vocabularies and the key regex pinned against
+  tools/check_jsonl_schema.py's stdlib-only copies;
+- ``cli layout`` exit contract (view needs --run-dir, golden renders).
+
+The full pop-64 x suite-8 exploration is gated end-to-end by
+tools/run_full_suite.py's ``layout_gate`` and ``bench.py --stage
+layout``; here it runs at reduced scale (pop 8, flat engine).
+"""
+import json
+import os
+import pathlib
+import sys
+
+import jax
+import numpy as np
+import pytest
+
+from fks_tpu import cli
+from fks_tpu.data.synthetic import synthetic_workload
+from fks_tpu.obs.history import RunHistory
+from fks_tpu.obs.layout import (
+    LAYOUT_AXES, LAYOUT_COMPONENTS, LEDGER, LayoutLedger, LayoutSpec,
+    cost_stats_of, default_spec, explore_layouts, parse_layout_key,
+    record_layout, rollup_layouts, tag_layout, valid_layouts,
+)
+from fks_tpu.models import parametric
+from fks_tpu.parallel.mesh import layout_mesh, make_sharded_eval
+from fks_tpu.scenarios import get_suite, make_sharded_suite_eval
+from fks_tpu.sim.engine import SimConfig
+
+FIXTURES = pathlib.Path(__file__).parent / "fixtures"
+GOLDEN = str(FIXTURES / "golden_run")
+
+
+class RecStub:
+    enabled = True
+
+    def __init__(self):
+        self.metrics = []
+
+    def metric(self, kind, *a, **fields):
+        rec = dict(a[0]) if a and isinstance(a[0], dict) else {}
+        rec.update(fields)
+        self.metrics.append({"kind": kind, **rec})
+
+
+# ----------------------------------------------------------------- spec
+
+def test_spec_key_canonicalizes_axis_order():
+    a = LayoutSpec(shard=("candidates",),
+                   vmap=("scenarios", "candidates"))
+    b = LayoutSpec(shard=("candidates",),
+                   vmap=("candidates", "scenarios"))
+    assert a.key == b.key == "shard[candidates]|vmap[candidates,scenarios]|seg=0"
+
+
+def test_spec_key_roundtrips():
+    for spec in (default_spec(), default_spec(scenarios=True),
+                 default_spec(seg_steps=128),
+                 LayoutSpec(shard=("candidates", "scenarios"),
+                            vmap=("candidates", "scenarios"))):
+        back = parse_layout_key(spec.key)
+        assert back == spec
+        assert back.key == spec.key
+
+
+def test_default_spec_keys():
+    assert default_spec().key == "shard[candidates]|vmap[candidates]|seg=0"
+    assert default_spec(scenarios=True).key == \
+        "shard[candidates]|vmap[candidates,scenarios]|seg=0"
+    assert default_spec(seg_steps=64).seg_steps == 64
+
+
+@pytest.mark.parametrize("kwargs,msg", [
+    (dict(shard=("candidates", "bogus"), vmap=("candidates", "bogus")),
+     "unknown layout axis"),
+    (dict(shard=("candidates", "candidates"),
+          vmap=("candidates",)), "duplicate"),
+    (dict(shard=("candidates", "segments"),
+          vmap=("candidates", "segments")), "host loop"),
+    (dict(shard=("scenarios",), vmap=("scenarios",)),
+     "'candidates' must shard"),
+    (dict(shard=("candidates", "scenarios"), vmap=("candidates",)),
+     "missing from vmap"),
+    (dict(seg_steps=-1), "seg_steps"),
+])
+def test_spec_validation_errors(kwargs, msg):
+    with pytest.raises(ValueError, match=msg):
+        LayoutSpec(**kwargs)
+
+
+@pytest.mark.parametrize("key", [
+    "", "shard[candidates]", "shard[candidates]|vmap[candidates]",
+    "shard[candidates]|vmap[candidates]|seg=x",
+    "shard[CANDIDATES]|vmap[candidates]|seg=0",
+    "vmap[candidates]|shard[candidates]|seg=0",
+])
+def test_parse_layout_key_rejects_malformed(key):
+    with pytest.raises(ValueError):
+        parse_layout_key(key)
+
+
+def test_parse_layout_key_rejects_out_of_vocabulary():
+    # matches the regex shape but names an unknown axis
+    with pytest.raises(ValueError, match="unknown layout axis"):
+        parse_layout_key("shard[candidates,pods]|vmap[candidates,pods]|seg=0")
+
+
+def test_tag_layout_best_effort():
+    def fn():
+        pass
+
+    assert tag_layout(fn, "k") is fn
+    assert fn._fks_layout_key == "k"
+    assert tag_layout(object(), "k") is not None  # slots: no raise
+
+
+# --------------------------------------------------------- valid layouts
+
+def test_valid_layouts_eight_devices_eight_scenarios():
+    lays = valid_layouts(8, 8)
+    assert [l["mesh_shape"] for l in lays] == ["8x1", "4x2", "2x4", "1x8"]
+    assert lays[0]["spec"] == default_spec(scenarios=True)
+    for l in lays[1:]:
+        assert "scenarios" in l["spec"].shard
+        assert l["candidate_shards"] * l["scenario_shards"] == 8
+
+
+def test_valid_layouts_scenario_divisibility():
+    # 3 scenarios: no s>1 divides both 8 devices and 3 scenarios
+    assert [l["mesh_shape"] for l in valid_layouts(8, 3)] == ["8x1"]
+    assert [l["mesh_shape"] for l in valid_layouts(4, 8)] == \
+        ["4x1", "2x2", "1x4"]
+    with pytest.raises(ValueError):
+        valid_layouts(0, 8)
+
+
+# --------------------------------------------------------------- ledger
+
+def test_ledger_dedupes_identical_consecutive_rows():
+    led = LayoutLedger(cap=8)
+    row = {"component": "eval", "layout_key": "k", "mesh_layout": "pop=8",
+           "workload_key": "w", "real_count": 64}
+    assert led.add(dict(row)) is True
+    assert led.add(dict(row)) is False          # identical repeat drops
+    changed = dict(row, real_count=65)
+    assert led.add(changed) is True             # changed padding lands
+    assert led.add(dict(row)) is True           # differs from the LAST row
+    assert len(led.records()) == 3
+
+
+def test_ledger_dedupe_is_per_identity_and_cap_trims():
+    led = LayoutLedger(cap=3)
+    row = lambda wk: {"component": "eval", "layout_key": "k",  # noqa: E731
+                      "mesh_layout": "", "workload_key": wk}
+    assert led.add(row("a")) is True
+    assert led.add(row("b")) is True
+    # interleaving does NOT defeat dedupe: last-row memory is per identity
+    assert led.add(row("a")) is False
+    assert led.add(row("b")) is False
+    led.clear()
+    for i in range(5):
+        led.add({"component": "eval", "layout_key": "k",
+                 "mesh_layout": "", "workload_key": str(i)})
+    assert [r["workload_key"] for r in led.records()] == ["2", "3", "4"]
+
+
+def test_record_layout_row_shape_and_dedupe():
+    LEDGER.clear()
+    stub = RecStub()
+    rec = record_layout("eval", default_spec(), workload_key="w",
+                        real_count=5, recorder=stub)
+    assert rec["component"] == "eval"
+    assert rec["layout_key"] == default_spec().key
+    assert rec["mesh_layout"] == ""             # no mesh given
+    assert rec["real_count"] == 5               # kept even without a mesh
+    assert rec["axes"] == ["candidates"]
+    assert record_layout("eval", default_spec(), workload_key="w",
+                         real_count=5, recorder=stub) is None  # deduped
+    assert [m["kind"] for m in stub.metrics] == ["layout_ledger"]
+    with pytest.raises(ValueError, match="unknown layout component"):
+        record_layout("controller", default_spec(), recorder=stub)
+    LEDGER.clear()
+
+
+def test_record_layout_folds_mesh_occupancy():
+    LEDGER.clear()
+    stub = RecStub()
+    mesh = layout_mesh(jax.devices(), 1)        # 8 candidate shards
+    rec = record_layout("suite_eval", default_spec(scenarios=True),
+                        mesh=mesh, workload_key="w", real_count=6,
+                        scenarios=8, recorder=stub)
+    assert rec["mesh_layout"] == "pop=8"        # s=1: plain pop mesh
+    assert rec["padded_count"] == 8
+    assert rec["pad_waste_fraction"] == pytest.approx(0.25)
+    assert rec["real_lane_steps"] == 6 * 8
+    assert rec["launched_lane_steps"] == 8 * 8
+    LEDGER.clear()
+
+
+def test_cost_stats_of_summarizes_and_degrades():
+    class Ok:
+        def cost_analysis(self):
+            return {"flops": 10.0, "bytes accessed": 100.0,
+                    "collective-permute bytes": 7.0, "utilization": 0.5}
+
+    class Listy(Ok):
+        def cost_analysis(self):
+            return [super().cost_analysis()]
+
+    class Broken:
+        def cost_analysis(self):
+            raise RuntimeError("no analysis")
+
+    want = {"cost_flops": 10.0, "cost_bytes_accessed": 100.0,
+            "collective_bytes": 7.0}
+    assert cost_stats_of(Ok()) == want
+    assert cost_stats_of(Listy()) == want
+    assert cost_stats_of(Broken()) == {}
+
+
+# --------------------------------------------------------------- rollup
+
+def test_rollup_layouts_math_and_hbm_join():
+    key = default_spec().key
+    rows = [
+        {"component": "eval", "layout_key": key, "mesh_layout": "pop=8",
+         "workload_key": "w", "real_count": 6, "padded_count": 8,
+         "pad_waste_fraction": 0.25, "real_lane_steps": 6,
+         "launched_lane_steps": 8, "steady_seconds": 0.5,
+         "compile_seconds": 2.0},
+        {"component": "probe", "layout_key": key, "mesh_layout": "pop=8",
+         "workload_key": "w", "real_count": 8, "padded_count": 8,
+         "pad_waste_fraction": 0.0, "real_lane_steps": 8,
+         "launched_lane_steps": 8, "steady_seconds": 0.25,
+         "compile_seconds": 3.0, "cost_bytes_accessed": 1e6},
+        {"component": "serve", "layout_key": key, "mesh_layout": "pop=4",
+         "workload_key": "w"},
+    ]
+    feet = [{"mesh_layout": "pop=8", "total_bytes": 1000},
+            {"mesh_layout": "pop=8", "total_bytes": 4000},
+            {"mesh_layout": "pop=2", "total_bytes": 9000}]
+    agg = rollup_layouts(rows, feet)
+    assert len(agg) == 2
+    eight = next(a for a in agg if a["mesh_layout"] == "pop=8")
+    assert eight["rows"] == 2
+    assert eight["components"] == ["eval", "probe"]
+    assert eight["occupancy"] == pytest.approx(14 / 16)
+    assert eight["pad_waste_fraction_max"] == pytest.approx(0.25)
+    assert eight["real_count"] == 8             # latest padded row wins
+    assert eight["steady_seconds"] == pytest.approx(0.25)   # best
+    assert eight["compile_seconds"] == pytest.approx(3.0)   # worst
+    assert eight["cost_bytes_accessed"] == pytest.approx(1e6)
+    assert eight["predicted_hbm_bytes"] == 4000  # largest same-mesh claim
+    four = next(a for a in agg if a["mesh_layout"] == "pop=4")
+    assert four["occupancy"] == 1.0             # no lane-step rows
+    assert "predicted_hbm_bytes" not in four    # no pop=4 footprint
+
+
+# --------------------------------------------- default-spec bit-identity
+
+def test_default_layout_lowers_bit_identically():
+    wl = synthetic_workload(8, 12, seed=0)
+    mesh = layout_mesh(jax.devices()[:1], 1)
+    params = parametric.init_population(jax.random.PRNGKey(0), 2)
+    implicit = make_sharded_eval(wl, mesh, cfg=SimConfig(), elite_k=2,
+                                 engine="flat")
+    explicit = make_sharded_eval(wl, mesh, cfg=SimConfig(), elite_k=2,
+                                 engine="flat", layout=default_spec())
+    assert str(jax.make_jaxpr(implicit)(params)) == \
+        str(jax.make_jaxpr(explicit)(params))
+
+    suite = get_suite("smoke3", wl)
+    implicit = make_sharded_suite_eval(suite, mesh, cfg=SimConfig(),
+                                       elite_k=2, engine="flat")
+    explicit = make_sharded_suite_eval(
+        suite, mesh, cfg=SimConfig(), elite_k=2, engine="flat",
+        layout=default_spec(scenarios=True))
+    assert str(jax.make_jaxpr(implicit)(params)) == \
+        str(jax.make_jaxpr(explicit)(params))
+
+
+def test_default_layout_pin_present():
+    doc = json.loads((FIXTURES / "jaxpr_pins.json").read_text())
+    assert "sharded_eval/default_layout" in doc["pins"]
+
+
+def test_sharded_entry_points_carry_layout_tags():
+    wl = synthetic_workload(8, 12, seed=0)
+    mesh = layout_mesh(jax.devices()[:1], 1)
+    ev = make_sharded_eval(wl, mesh, cfg=SimConfig(), elite_k=2,
+                           engine="flat")
+    assert ev._fks_layout_key == default_spec().key
+    sv = make_sharded_suite_eval(get_suite("smoke3", wl), mesh,
+                                 cfg=SimConfig(), elite_k=2, engine="flat")
+    assert sv._fks_layout_key == default_spec(scenarios=True).key
+
+
+# ------------------------------------------------------------- explorer
+
+def test_explore_layouts_parity_summary_and_prior(tmp_path):
+    LEDGER.clear()
+    stub = RecStub()
+    wl = synthetic_workload(8, 16, seed=0)
+    suite = get_suite("default8", wl)
+    history = RunHistory(str(tmp_path))
+    summary = explore_layouts(suite, population=8, elite_k=4,
+                              engine="flat", recorder=stub,
+                              history=history, workload_key="pop8_default8",
+                              reps=1)
+    assert summary["layouts_probed"] == 4       # 8x1, 4x2, 2x4, 1x8
+    assert summary["devices"] == 8 and summary["scenarios"] == 8
+    assert summary["default_layout_key"] == default_spec(scenarios=True).key
+    assert summary["parity_max_abs"] < 1e-6     # x64: layouts agree
+    assert summary["layout_best_over_default"] >= 1.0
+    assert 0.0 <= summary["layout_pad_waste_frac"] < 1.0
+    shapes = [p["mesh_shape"] for p in summary["probes"]]
+    assert shapes[0] == "8x1" and summary["best_mesh_shape"] in shapes
+    # one layout_probe metric per layout, plus the ledger rows
+    kinds = [m["kind"] for m in stub.metrics]
+    assert kinds.count("layout_probe") == 4
+    assert kinds.count("layout_ledger") >= 4
+    # the best measured layout persisted as a prior and reads back
+    prior = history.layout_prior("pop8_default8", "8")
+    assert prior is not None
+    assert prior["layout_key"] == summary["best_layout_key"]
+    assert prior["mesh_shape"] == summary["best_mesh_shape"]
+    assert prior["layout_best_over_default"] == \
+        summary["layout_best_over_default"]
+    LEDGER.clear()
+
+
+def test_history_layout_prior_roundtrip(tmp_path):
+    h = RunHistory(str(tmp_path))
+    assert h.layout_prior("w", "8") is None
+    h.record_layout_prior("w", "8", "k1", {"steady_seconds": 0.5})
+    h.record_layout_prior("w", "8", "k2", {"steady_seconds": 0.4})
+    h.record_layout_prior("w", "4", "k3")
+    assert h.layout_prior("w", "8")["layout_key"] == "k2"  # newest wins
+    assert h.layout_prior("w", "4")["layout_key"] == "k3"
+    # corrupted store degrades to empty, never raises
+    (tmp_path / "layouts.json").write_text("{broken")
+    assert h.layout_prior("w", "8") is None
+
+
+# ------------------------------------------------- vocabulary pinning
+
+def _schema_tool():
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools"))
+    try:
+        import check_jsonl_schema as cjs
+    finally:
+        sys.path.pop(0)
+    return cjs
+
+
+def test_vocabularies_pinned_against_schema_tool():
+    cjs = _schema_tool()
+    assert set(LAYOUT_AXES) == cjs.LAYOUT_AXES
+    assert set(LAYOUT_COMPONENTS) == cjs.LAYOUT_COMPONENTS
+    for spec in (default_spec(), default_spec(scenarios=True),
+                 LayoutSpec(shard=("candidates", "scenarios"),
+                            vmap=("candidates", "scenarios"),
+                            seg_steps=256)):
+        assert cjs._LAYOUT_KEY_RE.match(spec.key)
+    assert not cjs._LAYOUT_KEY_RE.match("shard[x]|vmap[x]")
+
+
+# ----------------------------------------------------------- cli layout
+
+def test_cli_layout_requires_a_mode(capsys):
+    assert cli.main(["layout"]) == 2
+
+
+def test_cli_layout_view_golden(capsys):
+    assert cli.main(["layout", "--run-dir", GOLDEN]) == 0
+    out = capsys.readouterr().out
+    assert "layout" in out
+    assert default_spec(scenarios=True).key in out
+    assert "4x2" in out                          # the golden probe row

@@ -126,6 +126,16 @@ LEAK_LOOPS = {"serve_batch", "vm_swap", "promotion", "evolve_generation",
 #: fks_tpu.obs.workload.LOADGEN_MODES; tests/test_workload.py pins the
 #: two copies) — the arrival process that produced the numbers
 LOADGEN_MODES = {"open", "closed", "mixed"}
+#: closed vocabulary of batchable layout axes, and the components that
+#: may file layout_ledger rows (duplicated from fks_tpu.obs.layout
+#: .LAYOUT_AXES / .LAYOUT_COMPONENTS; tests/test_layout.py pins the two
+#: copies) — which axis a LayoutSpec shards/vmaps, and who recorded it
+LAYOUT_AXES = {"candidates", "scenarios", "segments"}
+LAYOUT_COMPONENTS = {"eval", "code_eval", "gen_step", "suite_eval",
+                     "serve", "vm_serve", "probe", "bench"}
+#: canonical LayoutSpec key shape (fks_tpu.obs.layout.LayoutSpec.key)
+_LAYOUT_KEY_RE = re.compile(
+    r"^shard\[[a-z_,]*\]\|vmap\[[a-z_,]*\]\|seg=\d+$")
 METRIC_KIND_REQUIRED: Dict[str, Tuple[str, ...]] = {
     "generation": ("generation", "best_score"),
     "parity": ("generation", "checked", "max_drift"),
@@ -197,6 +207,13 @@ METRIC_KIND_REQUIRED: Dict[str, Tuple[str, ...]] = {
     "loadgen_summary": ("mode", "requests", "loadgen_qps",
                         "loadgen_p99_ms", "loadgen_shed_rate",
                         "loadgen_fairness_index"),
+    # per-layout cost ledger (fks_tpu.obs.layout): one row per sharded
+    # entry point wiring/launch, tagged with the canonical LayoutSpec key
+    # and the mesh layout it ran on
+    "layout_ledger": ("component", "layout_key", "mesh_layout"),
+    # layout explorer (fks_tpu.obs.layout.explore_layouts): one warm
+    # probe per valid layout of a (population x suite x mesh) shape
+    "layout_probe": ("layout_key", "mesh_shape", "steady_seconds"),
 }
 
 #: an OpenMetrics sample line: name, optional {labels}, value, optional
@@ -306,6 +323,23 @@ def check_kinds(path: str, records: List[dict],
                 raise SchemaError(
                     f"{path}: record {i + 1}: unknown loadgen mode "
                     f"{mode!r} (expect one of {sorted(LOADGEN_MODES)})")
+        elif rec.get("kind") in ("layout_ledger", "layout_probe"):
+            lk = rec.get("layout_key")
+            if not isinstance(lk, str) or not _LAYOUT_KEY_RE.match(lk):
+                raise SchemaError(
+                    f"{path}: record {i + 1}: malformed layout_key {lk!r} "
+                    "(expect 'shard[...]|vmap[...]|seg=N')")
+            for ax in rec.get("axes", []):
+                if ax not in LAYOUT_AXES:
+                    raise SchemaError(
+                        f"{path}: record {i + 1}: unknown layout axis "
+                        f"{ax!r} (expect one of {sorted(LAYOUT_AXES)})")
+            if rec.get("kind") == "layout_ledger" \
+                    and rec.get("component") not in LAYOUT_COMPONENTS:
+                raise SchemaError(
+                    f"{path}: record {i + 1}: unknown layout component "
+                    f"{rec.get('component')!r} (expect one of "
+                    f"{sorted(LAYOUT_COMPONENTS)})")
         elif rec.get("kind") == "decision_trace":
             _check_embedded_events(path, i, rec.get("events", []))
         elif rec.get("kind") == "trace_diff":

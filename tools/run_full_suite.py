@@ -374,6 +374,53 @@ def loadgen_gate() -> dict:
     return {"ok": ok, **detail}
 
 
+def layout_gate() -> dict:
+    """Layout observability: ``cli layout --explore`` on the 8-device
+    dryrun mesh must find >= 2 distinct valid layouts of pop-16 x
+    suite-8 with every layout's robust scores parity-equal to the
+    default (<= 1e-5), and the pinned default-spec jaxpr must be
+    unchanged (``cli lint``'s sharded_eval/default_layout pin, checked
+    by the lint gate). The explore run itself must NOT fail on
+    dominance — the dryrun mesh time-slices one host, so the default
+    being beaten there is expected and informational; the gate asserts
+    the measurement machinery, not a schedule. Returns
+    {"ok": bool, ...}."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    detail = {}
+    ok = True
+    with tempfile.TemporaryDirectory() as tmp:
+        proc = subprocess.run(
+            [sys.executable, "-m", "fks_tpu.cli", "layout", "--explore",
+             "--cpu", "--devices", "8", "--pop", "16",
+             "--suite", "default8", "--history-root", tmp],
+            capture_output=True, text=True, cwd=REPO, env=env,
+            timeout=900)
+        # rc 1 is the dominance verdict, not a machinery failure
+        detail["rc"] = proc.returncode
+        if proc.returncode not in (0, 1):
+            detail["err"] = (proc.stderr or proc.stdout or "")[-500:]
+            return {"ok": False, **detail}
+        try:
+            summary = json.loads(proc.stdout)
+        except json.JSONDecodeError:
+            detail["err"] = (proc.stdout or "")[-500:]
+            return {"ok": False, **detail}
+        detail["layouts_probed"] = summary.get("layouts_probed", 0)
+        detail["parity_max_abs"] = summary.get("parity_max_abs")
+        detail["best_mesh_shape"] = summary.get("best_mesh_shape")
+        if summary.get("layouts_probed", 0) < 2:
+            ok = False
+            detail["err"] = "fewer than 2 distinct valid layouts probed"
+        if float(summary.get("parity_max_abs", 1.0)) > 1e-5:
+            ok = False
+            detail["err"] = (f"layout parity {summary.get('parity_max_abs')}"
+                             " > 1e-5")
+        prior = os.path.join(tmp, "layouts.json")
+        detail["prior_written"] = os.path.exists(prior)
+        ok = ok and detail["prior_written"]
+    return {"ok": ok, **detail}
+
+
 def _write_history(root: str, values) -> None:
     now = time.time()
     for i, v in enumerate(values):
@@ -459,6 +506,9 @@ def main() -> int:
     dgate = loadgen_gate()
     if not dgate["ok"]:
         print(f"LOADGEN GATE FAILED: {dgate}", file=sys.stderr)
+    ogate = layout_gate()
+    if not ogate["ok"]:
+        print(f"LAYOUT GATE FAILED: {ogate}", file=sys.stderr)
     t0 = time.time()
     proc = subprocess.run(
         [sys.executable, "-m", "pytest", "tests/", "-q",
@@ -472,7 +522,8 @@ def main() -> int:
     gates_ok = (gate["ok"] and tgate["ok"] and sgate["ok"] and vgate["ok"]
                 and hgate["ok"] and lgate["ok"] and ngate["ok"]
                 and pgate["ok"] and rgate["ok"] and wgate["ok"]
-                and mgate["ok"] and ygate["ok"] and dgate["ok"])
+                and mgate["ok"] and ygate["ok"] and dgate["ok"]
+                and ogate["ok"])
     rc = proc.returncode if gates_ok else (proc.returncode or 1)
     row = {"ts": round(time.time(), 1), "rev": rev, "rc": rc,
            "wall_s": wall, **counts, "obs_gate": gate,
@@ -481,7 +532,8 @@ def main() -> int:
            "trends_gate": ngate, "promote_gate": pgate,
            "resilience_gate": rgate, "span_trace_gate": wgate,
            "vm_serve_gate": mgate, "memory_gate": ygate,
-           "loadgen_gate": dgate, "summary": summary}
+           "loadgen_gate": dgate, "layout_gate": ogate,
+           "summary": summary}
     os.makedirs(os.path.dirname(OUT), exist_ok=True)
     with open(OUT, "a") as f:
         f.write(json.dumps(row) + "\n")
