@@ -1988,6 +1988,149 @@ def stage_loadgen(gate: str = "") -> int:
     return rc
 
 
+def stage_portfolio(gate: str = "") -> int:
+    """CPU subprocess: multi-tenant portfolio serving headline
+    (fks_tpu.portfolio) — four resident champions in ONE slot-vmapped
+    VM executable behind the threaded HTTP front, two closed-loop
+    tenants pinned to different slots, and one slot promoted MID-RUN.
+    Measures the two gated keys:
+
+    - ``portfolio_qps``: completed queries/sec through the routed
+      front (all tenants, all slots, one executable);
+    - ``portfolio_slot_swap_ms``: wall time of the mid-traffic slot
+      promotion (transpile + pack + one slot-table H2D upload).
+
+    Plus ``portfolio_p99_ms``, the per-slot request mix (both pinned
+    slots must actually serve), and ``portfolio_promote_compiles``
+    (gated at 0 — promoting one slot under live traffic must never
+    touch XLA; the other slots' answers come from the same resident
+    executable throughout).
+
+    Env knobs: FKS_BENCH_PORTFOLIO_S (duration, default 6),
+    FKS_BENCH_PORTFOLIO_TENANTS (default "a:closed:2,b:closed:2").
+    """
+    import threading
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    from fks_tpu.data.synthetic import synthetic_workload
+    from fks_tpu.funsearch import template
+    from fks_tpu.obs import CompileWatcher
+    from fks_tpu.obs.workload import (
+        http_client, parse_tenant_spec, run_loadgen,
+    )
+    from fks_tpu.portfolio import PortfolioEngine, PortfolioService, Router
+    from fks_tpu.serve import ChampionSpec, ShapeEnvelope, make_http_server
+
+    global _RECORDER
+    _RECORDER = _controller_recorder()
+    duration = float(os.environ.get("FKS_BENCH_PORTFOLIO_S", "6"))
+    plan = parse_tenant_spec(os.environ.get(
+        "FKS_BENCH_PORTFOLIO_TENANTS", "a:closed:2,b:closed:2"))
+    logics = (
+        # raw-milli scores: genuinely distinct policies (the normalized
+        # variants all tie at int(1000) and would mask routing bugs)
+        "score = 1000",
+        "score = node.cpu_milli_left - pod.cpu_milli",
+        "score = node.memory_mib_left - pod.memory_mib",
+        "score = pod.cpu_milli - node.cpu_milli_left",
+    )
+    champs = [ChampionSpec(code=template.fill_template(lg),
+                           score=0.4 + 0.1 * i, source=f"<bench-{i}>")
+              for i, lg in enumerate(logics)]
+    watcher = CompileWatcher().install()
+    envelope = ShapeEnvelope(max_pods=8, min_pod_bucket=8, max_batch=2)
+    wl = synthetic_workload(16, 16, seed=3)
+    engine = PortfolioEngine(champs, wl, envelope=envelope, engine="flat",
+                             n_slots=5, recorder=_RECORDER)
+    engine.warmup()
+    router = Router(engine.n_slots, pins={"a": 1, "b": 2})
+    service = PortfolioService(engine, router=router, max_wait_s=0.002,
+                               accounting=True, recorder=_RECORDER)
+    server = make_http_server(service, 0)
+    port = server.server_address[1]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+
+    # warmup through the full HTTP path, then mark the compile counter:
+    # anything after this line — INCLUDING the mid-run slot promotion —
+    # is a steady-state recompile
+    http_client(port)({"tenant": "warmup",
+                       "pods": [dict(engine.base_pods[0])]})
+    marks = watcher.backend_compile_count
+    promoted = ChampionSpec(
+        code=template.fill_template(
+            "score = 3000 + (node.cpu_milli_left - pod.cpu_milli) "
+            "/ max(1, node.cpu_milli_total)"),
+        score=9.9, source="<bench-promoted>")
+    swap_ms = []
+
+    def _promote_midrun():
+        time.sleep(duration / 2)
+        t0 = time.perf_counter()
+        old = engine.swap_slot(3, promoted)
+        swap_ms.append((time.perf_counter() - t0) * 1e3)
+        del old
+
+    swapper = threading.Thread(target=_promote_midrun, daemon=True)
+    swapper.start()
+    summary = run_loadgen(http_client(port), plan, duration_s=duration,
+                          seed=0, recorder=_RECORDER)
+    swapper.join(timeout=30)
+    recompiles = watcher.backend_compile_count - marks
+    server.shutdown()
+    server.server_close()
+    service.close()
+    slot_mix = list(engine.slot_requests)
+
+    log(f"portfolio stage: {summary['requests']} requests in "
+        f"{summary['duration_s']}s — {summary['loadgen_qps']} qps, "
+        f"p99 {summary['loadgen_p99_ms']}ms, slot mix {slot_mix}, "
+        f"slot swap {swap_ms[0] if swap_ms else None}ms, "
+        f"recompiles {recompiles}")
+    payload = {
+        "portfolio_qps": summary["loadgen_qps"],
+        "portfolio_p99_ms": summary["loadgen_p99_ms"],
+        "portfolio_slot_swap_ms": (round(swap_ms[0], 3) if swap_ms
+                                   else None),
+        "portfolio_slot_mix": slot_mix,
+        "portfolio_slots": engine.n_slots,
+        "portfolio_capacity": engine.program_capacity,
+        "portfolio_requests": summary["requests"],
+        "portfolio_shed_rate": summary["loadgen_shed_rate"],
+        "portfolio_promote_compiles": recompiles,
+        "portfolio_routes": {k: v for k, v in router.routed.items() if v},
+        "engine": "flat",
+    }
+    _record("metric", "bench_stage", payload, stage="portfolio",
+            platform="cpu")
+    rc = 0
+    if summary["requests"] == 0 or summary["completed"] == 0:
+        log("FAIL: portfolio loadgen completed zero requests")
+        rc = 1
+    if summary["errors"]:
+        log(f"FAIL: {summary['errors']} portfolio requests errored")
+        rc = 1
+    if not swap_ms:
+        log("FAIL: mid-run slot promotion never completed")
+        rc = 1
+    if recompiles:
+        log(f"FAIL: {recompiles} recompiles across the mid-traffic slot "
+            "promotion — a slot swap must stay a table upload")
+        rc = 1
+    for slot in (1, 2):
+        if slot_mix[slot] == 0:
+            log(f"FAIL: pinned slot {slot} served zero requests — "
+                "routing or slot threading broke")
+            rc = 1
+    if gate:
+        rc = rc or _gate(gate, payload)
+    _record("finish", "ok" if rc == 0 else "fail")
+    _record("close")
+    print(json.dumps(payload))
+    return rc
+
+
 def stage_layout(gate: str = "") -> int:
     """CPU subprocess: measured layout sweep (fks_tpu.obs.layout) over
     the virtual 8-device dryrun mesh — enumerate every valid
@@ -2206,6 +2349,12 @@ def main():
         # qps, tail latency, shed rate, fairness, zero steady-state
         # recompiles, accounting overhead); same --gate contract
         return stage_loadgen(gate)
+    if stage == "portfolio":
+        # standalone portfolio-serving headline (routed multi-champion
+        # qps through one slot-vmapped executable, mid-traffic slot
+        # promotion latency, zero promote recompiles); same --gate
+        # contract
+        return stage_portfolio(gate)
     if stage == "layout":
         # standalone layout-sweep headline (valid layouts probed over
         # the dryrun mesh, best-vs-default steady ratio, pad waste,

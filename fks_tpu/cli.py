@@ -878,6 +878,179 @@ def cmd_loadgen(args):
     return 0
 
 
+#: deterministic built-in portfolio: four template logics with distinct
+#: placement behaviour, so the portfolio gate runs before any evolution
+#: has produced a ledger (and repeat runs are bit-identical)
+_PORTFOLIO_LOGICS = (
+    # raw-milli scores, NOT the normalized "+fit/total" variants: those
+    # collapse into all-tie constant policies under the template's
+    # int() truncation, and four behaviorally identical slots could not
+    # catch a cross-slot routing bug in the parity selftest
+    "score = 1000",
+    "score = node.cpu_milli_left - pod.cpu_milli",
+    "score = node.memory_mib_left - pod.memory_mib",
+    "score = pod.cpu_milli - node.cpu_milli_left",
+)
+
+
+def cmd_portfolio(args):
+    """Multi-tenant champion-portfolio serving (fks_tpu.portfolio): N
+    resident policies in ONE slot-vmapped VM executable, routed per
+    request. ``--selftest N`` runs the per-slot parity sweep (every
+    resident slot vs a single-champion VM engine, plus a mixed-slot
+    batch) and then promotes one slot mid-traffic under a compile
+    watcher — the run_full_suite portfolio gate. ``--http`` serves the
+    routed front instead."""
+    _apply_platform_flags(args)
+    from fks_tpu import obs
+    from fks_tpu.funsearch import template
+    from fks_tpu.portfolio import (
+        PortfolioEngine, PortfolioService, Router, portfolio_selftest,
+        vm_coverage_split,
+    )
+    from fks_tpu.serve import ChampionSpec, ShapeEnvelope, load_champion
+    from fks_tpu.serve.service import run_http
+
+    with _flight_recorder(args, "portfolio") as rec, obs.watch_compiles(rec):
+        mesh = None
+        if getattr(args, "devices", 0):
+            import jax
+            from fks_tpu.parallel import population_mesh
+            mesh = population_mesh(jax.devices()[:args.devices])
+        if args.champion:
+            champs = [load_champion(p) for p in args.champion]
+            _, wl = _parse_workload(args)
+        else:
+            from fks_tpu.data.synthetic import synthetic_workload
+            champs = [ChampionSpec(code=template.fill_template(lg),
+                                   score=0.5 + 0.1 * i,
+                                   source=f"<builtin-{i}>")
+                      for i, lg in enumerate(_PORTFOLIO_LOGICS)]
+            wl = synthetic_workload(16, 32, seed=args.seed)
+        n_pad = wl.cluster.n_padded
+        g_pad = wl.cluster.g_padded
+        resident, outside = vm_coverage_split(champs, n_pad, g_pad)
+        if not resident:
+            print("error: no champion is VM-lowerable at this cluster "
+                  "shape — a portfolio needs at least one resident slot",
+                  file=sys.stderr)
+            return 2
+        for c in outside:
+            print(f"champion {c.source or '<inline>'} outside the VM "
+                  "vocabulary; excluded from the slot table (serve it "
+                  "via the Router's AOT fallback)", file=sys.stderr)
+        n_slots = args.slots or len(resident) + 1  # +1 spare shadow slot
+        engine = PortfolioEngine(
+            resident, wl, n_slots=n_slots,
+            envelope=ShapeEnvelope(max_pods=args.max_pods,
+                                   max_batch=args.max_batch),
+            engine=args.engine, mesh=mesh, recorder=rec)
+        if rec.enabled:
+            rec.annotate_meta(
+                engine_kind=engine.engine_kind, n_slots=engine.n_slots,
+                program_capacity=engine.program_capacity,
+                slots=[c.source for c in engine.slot_champions])
+        print(f"portfolio: {len(resident)} resident / {len(outside)} "
+              f"fallback champions, {engine.n_slots} slots, "
+              f"capacity={engine.program_capacity}", file=sys.stderr)
+        engine.warmup()
+        if args.selftest:
+            return _portfolio_selftest_run(args, engine, resident,
+                                           portfolio_selftest, rec)
+        pins = {}
+        for spec in args.pin:
+            tenant, _, slot = spec.partition("=")
+            pins[tenant] = int(slot)
+        ab = {}
+        for spec in args.ab:
+            slot, _, weight = spec.partition("=")
+            ab[int(slot)] = float(weight)
+        router = Router(engine.n_slots, pins=pins, ab_split=ab or None)
+        service = PortfolioService(engine, router=router, recorder=rec,
+                                   max_wait_s=args.max_wait_ms / 1e3,
+                                   max_queue=args.max_queue,
+                                   accounting=True)
+        try:
+            if args.http:
+                print(f"listening on http://127.0.0.1:{args.http} "
+                      "(POST /query, GET /stats, GET /healthz)",
+                      file=sys.stderr)
+                run_http(service, args.http)
+            else:
+                from fks_tpu.serve.service import run_jsonl
+                run_jsonl(service)
+        finally:
+            service.close()
+            print(json.dumps(service.summary()), file=sys.stderr)
+    return 0
+
+
+def _portfolio_selftest_run(args, engine, resident, portfolio_selftest,
+                            rec):
+    """The gate body: per-slot + mixed-batch parity, then one slot
+    promoted mid-traffic with zero XLA compiles."""
+    import threading
+
+    from fks_tpu import obs
+    from fks_tpu.funsearch import template
+    from fks_tpu.serve import ChampionSpec
+
+    result = portfolio_selftest(engine, count=args.selftest,
+                                pods_per_query=args.pods_per_query,
+                                tol=args.audit_tol)
+    # mid-traffic slot promotion: hammer every resident slot from
+    # threads while one slot's tables are swapped out and back — the
+    # zero-compile contract under concurrency, on this exact build
+    target = min(1, engine.n_slots - 1)
+    promoted = ChampionSpec(
+        code=template.fill_template(
+            "score = 3000 + (node.cpu_milli_left - pod.cpu_milli) "
+            "/ max(1, node.cpu_milli_total)"),
+        score=9.9, source="<promoted>")
+    base = engine.base_pods
+    stop = threading.Event()
+    errors = []
+
+    def _hammer(slot):
+        i = 0
+        while not stop.is_set():
+            q = [dict(base[(i + j) % len(base)]) for j in range(3)]
+            try:
+                ans = engine.answer_batch([q], slots=[slot])[0]
+                if ans.get("score") is None:
+                    errors.append(f"slot {slot}: empty answer")
+            except Exception as e:  # noqa: BLE001 — surfaced in result
+                errors.append(f"slot {slot}: {type(e).__name__}: {e}")
+                return
+            i += 1
+
+    watcher = obs.CompileWatcher().install()
+    try:
+        threads = [threading.Thread(target=_hammer, args=(s,))
+                   for s in range(min(len(resident), engine.n_slots))]
+        for t in threads:
+            t.start()
+        old = engine.swap_slot(target, promoted)
+        engine.swap_slot(target, old)
+        stop.set()
+        for t in threads:
+            t.join(timeout=60)
+        compiles = watcher.backend_compile_count
+    finally:
+        stop.set()
+        watcher.uninstall()
+    result["swap"] = {"slot": target, "swaps": 2, "compiles": compiles,
+                      "errors": errors[:5],
+                      **{k: engine.last_swap_breakdown[k]
+                         for k in ("swap_ms", "h2d_ms", "h2d_bytes")}}
+    result["ok"] = bool(result["ok"] and compiles == 0 and not errors)
+    if rec.enabled:
+        rec.metric("portfolio_selftest", **{
+            k: v for k, v in result.items() if k != "failures"})
+    print(json.dumps(result, indent=2))
+    return 0 if result["ok"] else 1
+
+
 def cmd_pipeline(args):
     """Promotion-pipeline utilities (fks_tpu.pipeline). Default: print
     the promotion.jsonl state-machine status (per-attempt states, the
@@ -1793,6 +1966,60 @@ def main(argv=None) -> int:
                     help="emit tenant_stats/workload_mix every N served "
                          "requests (default 100)")
     lg.set_defaults(fn=cmd_loadgen)
+
+    pf = sub.add_parser(
+        "portfolio",
+        help="serve N resident champions from ONE slot-vmapped VM "
+             "executable with per-request routing (pin / affinity / "
+             "A-B / coverage fallback)",
+        parents=[common])
+    _add_trace_flags(pf)
+    pf.add_argument("--champion", action="append", default=[],
+                    help="champion JSON to load into a slot (repeatable; "
+                         "default: four deterministic built-in template "
+                         "champions over a synthetic workload)")
+    pf.add_argument("--slots", type=int, default=0,
+                    help="slot-table size (default: resident champions "
+                         "+ 1 spare shadow slot)")
+    pf.add_argument("--seed", type=int, default=0,
+                    help="synthetic-workload seed for the built-in "
+                         "champion set (default 0)")
+    pf.add_argument("--devices", type=int, default=0,
+                    help="mesh-sharded serving: size a virtual CPU "
+                         "device mesh (requires --cpu) and shard the "
+                         "lane axis over it; the slot table is "
+                         "replicated (0 = single-device engine)")
+    pf.add_argument("--max-pods", type=int, default=64,
+                    help="shape envelope: largest query (default 64)")
+    pf.add_argument("--max-batch", type=int, default=4,
+                    help="shape envelope: largest coalesced batch "
+                         "(default 4)")
+    pf.add_argument("--max-wait-ms", type=float, default=5.0,
+                    help="flush policy: max ms the oldest pending "
+                         "request waits for batch-mates (default 5)")
+    pf.add_argument("--max-queue", type=int, default=0,
+                    help="bounded queue depth for admission-control "
+                         "shedding (0 = unbounded)")
+    pf.add_argument("--pin", action="append", default=[],
+                    help="tenant pin rule tenant=slot (repeatable)")
+    pf.add_argument("--ab", action="append", default=[],
+                    help="A/B split rule slot=weight (repeatable; "
+                         "weights normalized; assignment keyed by a "
+                         "deterministic request-id hash)")
+    pf.add_argument("--http", type=int, default=0,
+                    help="serve a localhost HTTP listener on this port "
+                         "instead of JSONL over stdin")
+    pf.add_argument("--selftest", type=int, default=0,
+                    help="run the per-slot + mixed-batch parity sweep "
+                         "with N queries per slot, then promote one "
+                         "slot mid-traffic under a compile watcher, "
+                         "and exit (nonzero on drift or any compile) — "
+                         "the run_full_suite portfolio gate")
+    pf.add_argument("--pods-per-query", type=int, default=3,
+                    help="query size for --selftest (default 3)")
+    pf.add_argument("--audit-tol", type=float, default=1e-5,
+                    help="selftest score drift tolerance")
+    pf.set_defaults(fn=cmd_portfolio)
 
     pp = sub.add_parser(
         "pipeline", parents=[common],

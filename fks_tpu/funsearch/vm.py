@@ -727,6 +727,20 @@ def stack_programs(progs: Sequence[VMProgram],
     return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *padded)
 
 
+def select_slot(stacked: VMProgram, slot) -> VMProgram:
+    """One member of a ``stack_programs`` pytree by (possibly traced) slot
+    index — the portfolio serve tier's per-lane dispatch primitive.
+
+    Under ``vmap`` with the stacked program broadcast (``in_axes=None``)
+    and ``slot`` batched per lane, this lowers to one gather per table, so
+    a single executable answers a batch that MIXES champions: each lane
+    reads its own opcode/operand rows out of the resident slot tables.
+    The selected program's ``capacity`` stays shape-derived (static under
+    tracing); ``n_ops``/``out_reg`` become traced scalars, which
+    ``score_static`` never uses as loop bounds."""
+    return jax.tree_util.tree_map(lambda x: x[slot], stacked)
+
+
 def bucket_lanes(n: int, multiple: int = 1) -> int:
     """Lane count for a batch of ``n`` programs: the next power of two
     (so the jitted population runner retraces per BUCKET, never per

@@ -112,6 +112,14 @@ DEFAULT_THRESHOLDS: Dict[str, Threshold] = {
     "loadgen_shed_rate": Threshold(higher_is_better=False, abs_tol=0.02),
     "loadgen_fairness_index": Threshold(higher_is_better=True,
                                         abs_tol=0.05),
+    # portfolio serving (bench stage_portfolio): routed multi-champion
+    # throughput through the shared slot-vmapped executable must not
+    # drop >10%, and the mid-traffic slot promotion must stay a table
+    # upload — same latency treatment as the single-slot swap (25% rel
+    # with a 2 ms CPU-jitter floor)
+    "portfolio_qps": Threshold(higher_is_better=True, rel=0.10),
+    "portfolio_slot_swap_ms": Threshold(higher_is_better=False, rel=0.25,
+                                        abs_tol=2.0),
     # layout explorer (bench stage_layout): best-measured-over-default
     # steady ratio must not drop more than 10 points (a drop means the
     # default layout got relatively worse, or the explorer stopped
@@ -159,7 +167,7 @@ def _from_run_dir(run_dir: str) -> Dict[str, float]:
                     "scale1k_events_per_sec", "serve_qps",
                     "serve_sharded_qps", "preflight_reject_rate",
                     "loadgen_qps", "loadgen_fairness_index",
-                    "layout_best_over_default"):
+                    "portfolio_qps", "layout_best_over_default"):
             v = _num(m.get(key))
             if v is not None:
                 out[key] = max(out.get(key, 0.0), v)
@@ -168,7 +176,8 @@ def _from_run_dir(run_dir: str) -> Dict[str, float]:
         for key in ("serve_p99_ms", "serve_h2d_bytes_per_query",
                     "trace_overhead_pct", "promotion_swap_ms",
                     "vm_swap_h2d_bytes", "loadgen_p99_ms",
-                    "loadgen_shed_rate", "layout_pad_waste_frac"):
+                    "loadgen_shed_rate", "portfolio_slot_swap_ms",
+                    "layout_pad_waste_frac"):
             v = _num(m.get(key))
             if v is not None:
                 out[key] = min(out.get(key, v), v)
@@ -220,6 +229,7 @@ def _from_jsonl(path: str, allow_stale: bool = False) -> Dict[str, float]:
                     "vm_swap_h2d_bytes", "peak_device_bytes",
                     "exe_temp_bytes", "loadgen_qps", "loadgen_p99_ms",
                     "loadgen_shed_rate", "loadgen_fairness_index",
+                    "portfolio_qps", "portfolio_slot_swap_ms",
                     "layout_best_over_default", "layout_pad_waste_frac"):
             v = _num(rec.get(key))
             if v is None:
@@ -234,7 +244,8 @@ def _from_jsonl(path: str, allow_stale: bool = False) -> Dict[str, float]:
             if key in ("compile_seconds", "serve_p99_ms",
                        "serve_h2d_bytes_per_query", "trace_overhead_pct",
                        "promotion_swap_ms", "vm_swap_h2d_bytes",
-                       "loadgen_p99_ms", "loadgen_shed_rate"):
+                       "loadgen_p99_ms", "loadgen_shed_rate",
+                       "portfolio_slot_swap_ms"):
                 out[key] = min(out.get(key, v), v)
             elif key in ("peak_device_bytes", "exe_temp_bytes"):
                 # peak metrics: the high-water mark across records

@@ -303,6 +303,36 @@ def to_openmetrics(run_dir: str) -> str:
             fam(key, "gauge", help_).add(
                 m.get(key), run_id=run_id, mode=m.get("mode"))
 
+    # portfolio routing (fks_tpu.portfolio): per-slot routed-request
+    # counts and per-rule routing decisions over the whole run, plus
+    # per-slot promotion counts from slot_swap events
+    route_slots: Dict[Any, int] = {}
+    route_reasons: Dict[Any, int] = {}
+    for m in (m for m in metrics if m.get("kind") == "portfolio_route"):
+        slot = m.get("slot")
+        route_slots[slot] = route_slots.get(slot, 0) + 1
+        reason = m.get("reason")
+        route_reasons[reason] = route_reasons.get(reason, 0) + 1
+    for slot in sorted(route_slots, key=str):
+        fam("portfolio_slot_requests", "gauge",
+            "requests routed to this portfolio slot over the run "
+            "(slot -1 = AOT coverage-fallback engine)").add(
+            route_slots[slot], run_id=run_id, slot=slot)
+    for reason in sorted(route_reasons, key=str):
+        fam("portfolio_route_decisions", "gauge",
+            "routing decisions by rule (pin / affinity / ab / default "
+            "/ fallback / query)").add(
+            route_reasons[reason], run_id=run_id, reason=reason)
+    slot_swaps: Dict[Any, int] = {}
+    for e in (e for e in events if e.get("kind") == "slot_swap"):
+        slot = e.get("slot")
+        slot_swaps[slot] = slot_swaps.get(slot, 0) + 1
+    for slot in sorted(slot_swaps, key=str):
+        fam("portfolio_slot_swaps", "gauge",
+            "slot-table promotions into this portfolio slot "
+            "(each one a zero-compile H2D upload)").add(
+            slot_swaps[slot], run_id=run_id, slot=slot)
+
     # device-resident snapshot cache (ServeEngine content-hash ktable
     # cache): reuse vs upload economics of the sharded serve path
     latest_cache = None

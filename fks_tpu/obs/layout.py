@@ -57,7 +57,8 @@ LAYOUT_AXES = ("candidates", "scenarios", "segments")
 #: components that may file layout rows (closed vocabulary, mirrored in
 #: tools/check_jsonl_schema.py; keep in sync)
 LAYOUT_COMPONENTS = ("eval", "code_eval", "gen_step", "suite_eval",
-                     "serve", "vm_serve", "probe", "bench")
+                     "serve", "vm_serve", "portfolio_serve", "probe",
+                     "bench")
 
 _KEY_RE = re.compile(
     r"^shard\[(?P<shard>[a-z_,]*)\]\|vmap\[(?P<vmap>[a-z_,]*)\]"

@@ -469,6 +469,44 @@ def _tenant_section(metrics: List[Dict[str, Any]]) -> List[str]:
     return lines
 
 
+def _portfolio_section(metrics: List[Dict[str, Any]],
+                       events: List[Dict[str, Any]]) -> List[str]:
+    """Portfolio serving (fks_tpu.portfolio): routed-request counts per
+    slot and per rule over the whole run, plus every slot promotion —
+    which slot, what it cost, and whether the transpile overlapped the
+    shadow window."""
+    routes = [m for m in metrics if m.get("kind") == "portfolio_route"]
+    swaps = [e for e in events if e.get("kind") == "slot_swap"]
+    if not (routes or swaps):
+        return []
+    lines = ["portfolio (fks_tpu.portfolio):"]
+    if routes:
+        by_slot: Dict[str, int] = {}
+        by_reason: Dict[str, int] = {}
+        for m in routes:
+            by_slot[str(m.get("slot", "?"))] = \
+                by_slot.get(str(m.get("slot", "?")), 0) + 1
+            by_reason[str(m.get("reason", "?"))] = \
+                by_reason.get(str(m.get("reason", "?")), 0) + 1
+        mix = ", ".join(f"slot {s}={n}" for s, n in sorted(
+            by_slot.items(), key=lambda kv: kv[0]))
+        rules = ", ".join(f"{r}={n}" for r, n in sorted(
+            by_reason.items(), key=lambda kv: -kv[1]))
+        lines.append(f"  {len(routes)} routed requests — {mix}")
+        lines.append(f"  routing rules: {rules}")
+    if swaps:
+        lines.append(f"  slot promotions: {len(swaps)}")
+        for e in swaps[-5:]:
+            overlap = (" (transpile overlapped)"
+                       if e.get("transpile_overlapped") else "")
+            lines.append(
+                f"    slot {e.get('slot', '?')} <- "
+                f"{e.get('champion', '?')}: "
+                f"swap {_num(float(e.get('swap_ms', 0.0)), 2)}ms, "
+                f"h2d {e.get('h2d_bytes', 0)}B{overlap}")
+    return lines
+
+
 def _bench_section(metrics: List[Dict[str, Any]]) -> List[str]:
     stages = [m for m in metrics if m.get("kind") == "bench_stage"]
     lines = []
@@ -534,6 +572,7 @@ def render_report(run_dir: str) -> str:
                     _budget_section(metrics), _bench_section(metrics),
                     _device_profile_section(metrics), _slo_section(metrics),
                     _tenant_section(metrics),
+                    _portfolio_section(metrics, events),
                     _memory_section(metrics), _layout_section(metrics),
                     _compile_section(events),
                     _span_section(events)):

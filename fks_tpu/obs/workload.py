@@ -258,6 +258,16 @@ class TenantAccountant:
         with self._lock:
             self._slot(tenant).expired += 1
 
+    def ewma_service_s(self, tenant: str) -> Optional[float]:
+        """This tenant's EWMA service time in SECONDS, or None while the
+        tenant is cold — the per-tenant Retry-After source the admission
+        controller plugs in (``AdmissionController.service_time_for``)."""
+        with self._lock:
+            s = self._slots.get(tenant)
+            if s is None or not s.requests:
+                return None
+            return s.ewma_ms / 1e3
+
     def _elapsed(self) -> float:
         return (self._t_last - self._t_first) \
             if self._t_first is not None else 0.0

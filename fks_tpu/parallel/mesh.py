@@ -323,6 +323,26 @@ def make_sharded_vm_serve_fn(serve_fn, mesh: Mesh, layout=None):
     return tag_layout(fn, spec.key)
 
 
+def make_sharded_portfolio_serve_fn(serve_fn, mesh: Mesh, layout=None):
+    """``make_sharded_vm_serve_fn`` for the portfolio serving pipeline
+    ``(slot_tables, slots, pods, ktable, state0) -> SimResult``: the
+    stacked per-slot program tables (argument 0) are REPLICATED exactly
+    like the single champion's tables — every device holds the FULL
+    portfolio, so any lane on any device can dispatch to any slot — while
+    the per-lane slot indices (argument 1) shard with the batch axes they
+    index. Lanes stay collective-free: slot dispatch is a local gather
+    into the replicated tables. Layout-tagged with component
+    "portfolio_serve"."""
+    from fks_tpu.obs.layout import record_layout, tag_layout
+    spec = _resolve_layout(layout)
+    axes = _pop_axes(mesh)
+    fn = shard_map(serve_fn, mesh=mesh,
+                   in_specs=(P(), P(axes), P(axes), P(axes), P(axes)),
+                   out_specs=P(axes), check_vma=False)
+    record_layout("portfolio_serve", spec, mesh=mesh)
+    return tag_layout(fn, spec.key)
+
+
 def _global_results(run, state0, params_shard, axes):
     """Per-shard batched SimResult + the all-gather of the full population
     fitness vector (shared preamble of eval and generation-step). On a 1-D

@@ -174,12 +174,13 @@ class PromotionController:
                            champ.code.encode()).hexdigest()[:12],
                        attempt=aid, score=round(champ.score, 6))
         incumbent = self.service.engine
-        gain = champ.score - incumbent.champion.score
+        inc_spec = self._incumbent_spec(incumbent)
+        gain = champ.score - inc_spec.score
         if gain < self.cfg.min_score_gain or gain <= 0:
             return self._reject(
                 aid, path,
                 f"fitness: candidate {champ.score:.4f} vs incumbent "
-                f"{incumbent.champion.score:.4f} (gain {gain:+.4f} < "
+                f"{inc_spec.score:.4f} (gain {gain:+.4f} < "
                 f"required {max(self.cfg.min_score_gain, 0):g})")
         t0 = time.perf_counter()
         try:
@@ -194,6 +195,13 @@ class PromotionController:
                                 f"build_failed: {type(e).__name__}: {e}")
         self._transition(aid, "SHADOW", champion=path,
                          engine_kind=engine_kind)
+        # overlap the host-side transpile (~60ms on a cache miss) with
+        # the shadow replay: by the time the gate passes, the commit
+        # swap lowers from a warm cache entry (the swap's vm_swap /
+        # slot_swap event records transpile_overlapped)
+        if engine_kind == "vm" and hasattr(self.service.engine,
+                                           "begin_overlapped_transpile"):
+            self.service.engine.begin_overlapped_transpile(champ)
         try:
             with obs.span("shadow", attempt=aid):
                 verdict = self._shadow_eval(
@@ -213,15 +221,10 @@ class PromotionController:
         # commit point: PROMOTED lands in the log BEFORE the flip — a
         # kill between the two resolves to the new champion on restart
         self._transition(aid, "PROMOTED", champion=path,
-                         previous=incumbent.champion.source,
+                         previous=inc_spec.source,
                          engine_kind=engine_kind, shadow=_strip(verdict))
         t1 = time.perf_counter()
-        # the swap: VM fast path uploads the candidate's tables INTO the
-        # resident engine (swap_engine dispatches on ChampionSpec — no
-        # rebuild was ever on this path); AOT path flips to the prebuilt
-        # shadow engine. Either way the rollback handle comes back.
-        old = self.service.swap_engine(
-            champ if engine_kind == "vm" else shadow)
+        old = self._commit_swap(champ, shadow, engine_kind)
         self.last_swap_ms = round((time.perf_counter() - t1) * 1e3, 3)
         trace_ctx.emit(self.recorder, "promotion/swap",
                        self.last_swap_ms / 1e3, attempt=aid,
@@ -238,6 +241,26 @@ class PromotionController:
         return {"action": "promoted", "attempt": aid, "champion": path,
                 "swap_ms": self.last_swap_ms, "engine_kind": engine_kind,
                 "shadow": _strip(verdict)}
+
+    def _incumbent_spec(self, incumbent) -> ChampionSpec:
+        """The ChampionSpec a candidate competes against — the engine's
+        resident champion here; the FleetController narrows it to ONE
+        slot's champion."""
+        return incumbent.champion
+
+    def _commit_swap(self, champ: ChampionSpec, shadow, engine_kind: str):
+        """The swap itself, returning the rollback handle: VM fast path
+        uploads the candidate's tables INTO the resident engine
+        (swap_engine dispatches on ChampionSpec — no rebuild was ever on
+        this path); AOT path flips to the prebuilt shadow engine. The
+        FleetController overrides this (and ``_restore``) with a per-slot
+        table upload."""
+        return self.service.swap_engine(
+            champ if engine_kind == "vm" else shadow)
+
+    def _restore(self, old) -> None:
+        """Invert ``_commit_swap`` with its rollback handle."""
+        self.service.swap_engine(old)
 
     def _build_shadow(self, champ: ChampionSpec, incumbent, aid: str,
                       path: str):
@@ -393,7 +416,7 @@ class PromotionController:
         # log first (the durable commit), then flip back
         self._transition(aid, "ROLLED_BACK", champion=p["champion"],
                          reason="slo_burn", burn=burn)
-        self.service.swap_engine(p["old_engine"])
+        self._restore(p["old_engine"])
         self.recorder.event("rollback", attempt=aid, reason="slo_burn",
                             champion=p["champion"], **burn)
         self._probation = None

@@ -111,7 +111,8 @@ def run_drills(log: Callable[[str], None] = print,
                   _drill_p99_regression_rejected, _drill_kill_pending,
                   _drill_kill_shadow, _drill_kill_promoted,
                   _drill_rollback_on_burn, _drill_zero_recompile_swap,
-                  _drill_vm_double_swap, _drill_llm_outage,
+                  _drill_vm_double_swap, _drill_portfolio_slot_promotion,
+                  _drill_llm_outage,
                   *RESILIENCE_DRILLS):
         name = drill.__name__.replace("_drill_", "")
         if filters and not any(f in name for f in filters):
@@ -340,6 +341,74 @@ def _drill_vm_double_swap(stack: DrillStack) -> Dict[str, Any]:
                     "vm_swaps": incumbent.vm_swaps,
                     "swap_ms": incumbent.last_swap_breakdown.get(
                         "swap_ms", 0.0)}
+    finally:
+        service.close()
+
+
+def _drill_portfolio_slot_promotion(stack: DrillStack) -> Dict[str, Any]:
+    """Per-slot promotion inside the shared portfolio executable: the
+    FleetController stages the candidate in a spare shadow slot of the
+    LIVE executable, evaluates it on mirrored traffic, and commits it
+    into the target slot — zero XLA compiles end to end, and a
+    bystander slot's answers are bit-identical across the whole
+    lifecycle (promoting slot 1 must never perturb slot 2)."""
+    from fks_tpu.funsearch import template
+    from fks_tpu.obs import CompileWatcher
+    from fks_tpu.portfolio import (
+        FleetController, PortfolioEngine, PortfolioService, Router,
+    )
+    from fks_tpu.serve import ChampionSpec
+
+    second = template.fill_template(
+        "score = 2000 + (node.memory_mib_left - pod.memory_mib)"
+        " / max(1, node.memory_mib_total)")
+    champs = [
+        ChampionSpec(code=stack.incumbent_code, score=0.4,
+                     source="<slot0>"),
+        ChampionSpec(code=stack.candidate_code, score=0.5,
+                     source="<slot1>"),
+        ChampionSpec(code=second, score=0.6, source="<slot2>"),
+    ]
+    engine = PortfolioEngine(champs, stack.workload,
+                             envelope=stack.envelope, n_slots=4)
+    engine.warmup()
+    base = engine.base_pods
+    bystander_q = [dict(base[j]) for j in range(3)]
+    before = engine.answer_batch([bystander_q], slots=[2])[0]
+    service = PortfolioService(engine, router=Router(engine.n_slots),
+                               max_wait_s=0.002)
+    try:
+        with tempfile.TemporaryDirectory(prefix="fks_drill_") as tmp:
+            stack.traffic(service, 3)
+            ctrl = FleetController(
+                service, stack.workload, slot=1, shadow_slot=3,
+                ledger_dir=tmp,
+                log_path=os.path.join(tmp, "promotion.jsonl"),
+                config=PromotionConfig(shadow_queries=2))
+            promoted_code = template.fill_template(
+                "score = 3000 + (node.cpu_milli_left - pod.cpu_milli)"
+                " / max(1, node.cpu_milli_total)")
+            watcher = CompileWatcher().install()
+            try:
+                write_champion(tmp, promoted_code, 0.9)
+                verdict = ctrl.poll_once()
+                stack.traffic(service, 2)
+                recompiles = watcher.backend_compile_count
+            finally:
+                watcher.uninstall()
+            after = engine.answer_batch([bystander_q], slots=[2])[0]
+            return {"ok": (verdict["action"] == "promoted"
+                           and service.engine is engine
+                           and engine.slot_swaps[1] >= 1
+                           and recompiles == 0
+                           and after["score"] == before["score"]
+                           and after["placements"]
+                           == before["placements"]),
+                    "verdict": verdict["action"],
+                    "recompiles": recompiles,
+                    "slot_swaps": list(engine.slot_swaps),
+                    "bystander_drift":
+                        abs(after["score"] - before["score"])}
     finally:
         service.close()
 

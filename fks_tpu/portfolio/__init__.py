@@ -1,0 +1,28 @@
+"""Multi-tenant champion-portfolio serving.
+
+One warm executable, N resident policies: ``PortfolioEngine`` stacks N
+``VMProgram`` champions into a single slot-vmapped VM executable (the
+population-batched move applied to the serve tier), ``Router`` maps
+requests to slots (tenant pin / workload-class affinity / weighted A-B /
+coverage fallback), ``PortfolioService`` threads the slot index through
+the request batcher, and ``FleetController`` extends the promotion
+pipeline to per-slot lifecycle — shadow slots evaluated inside the live
+executable, promotion as one slot-table upload, zero XLA compiles.
+"""
+from fks_tpu.portfolio.engine import PortfolioEngine, portfolio_selftest
+from fks_tpu.portfolio.router import (
+    FALLBACK, ROUTE_REASONS, Router, vm_coverage_split,
+)
+from fks_tpu.portfolio.service import PortfolioService
+from fks_tpu.portfolio.fleet import FleetController
+
+__all__ = [
+    "FALLBACK",
+    "FleetController",
+    "PortfolioEngine",
+    "PortfolioService",
+    "ROUTE_REASONS",
+    "Router",
+    "portfolio_selftest",
+    "vm_coverage_split",
+]

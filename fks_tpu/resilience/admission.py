@@ -16,7 +16,7 @@ from __future__ import annotations
 
 import dataclasses
 import threading
-from typing import Optional
+from typing import Callable, Optional
 
 from fks_tpu.resilience.deadline import Deadline, ShedError
 
@@ -54,6 +54,17 @@ class AdmissionController:
         self.shed_queue_full = 0
         self.shed_deadline = 0
         self.expired = 0  # admitted but completed with DeadlineExceeded
+        # optional per-tenant service-time source (tenant -> seconds, or
+        # None while that tenant is cold): when the service runs with
+        # accounting on, this is TenantAccountant.ewma_service_s, and a
+        # shed request's Retry-After is priced at the SHEDDING tenant's
+        # observed service time instead of the single global EWMA — a
+        # slow tenant is told to back off longer, a fast one shorter
+        # (first step of weighted-fair shedding). Never called under the
+        # accountant's own lock from here (lock order: admission ->
+        # accountant, and the accountant never calls admission).
+        self.service_time_for: Optional[
+            Callable[[str], Optional[float]]] = None
 
     # ------------------------------------------------------------ signals
 
@@ -103,15 +114,19 @@ class AdmissionController:
 
     # ----------------------------------------------------------- decision
 
-    def admit(self, deadline: Optional[Deadline]) -> None:
+    def admit(self, deadline: Optional[Deadline],
+              tenant: Optional[str] = None) -> None:
         """Admit (incrementing depth) or raise ``ShedError``. Called by
-        ``RequestBatcher.submit`` before enqueueing."""
+        ``RequestBatcher.submit`` before enqueueing. ``tenant`` (when the
+        service threads it through) prices the shed hint per tenant; the
+        shed DECISION stays global — fairness of refusal is the queue's
+        concern, honesty of the back-off hint is the tenant's."""
         with self._lock:
             if self.cfg.max_queue and self._depth >= self.cfg.max_queue:
                 self.shed_queue_full += 1
                 raise ShedError(
                     f"queue full ({self._depth}/{self.cfg.max_queue})",
-                    retry_after_s=self._retry_after_locked(),
+                    retry_after_s=self._retry_after_locked(tenant),
                     reason="queue_full")
             if deadline is not None:
                 est = self._ewma_service_s
@@ -122,7 +137,7 @@ class AdmissionController:
                         f"projected wait {projected * 1e3:.1f}ms exceeds "
                         "deadline budget "
                         f"{max(0.0, deadline.remaining()) * 1e3:.1f}ms",
-                        retry_after_s=self._retry_after_locked(),
+                        retry_after_s=self._retry_after_locked(tenant),
                         reason="deadline_budget")
             self._depth += 1
             self.submitted += 1
@@ -132,6 +147,10 @@ class AdmissionController:
         with self._lock:
             self._depth = max(0, self._depth - n)
 
-    def _retry_after_locked(self) -> float:
+    def _retry_after_locked(self, tenant: Optional[str] = None) -> float:
         est = self._ewma_service_s or 0.0
+        if tenant and self.service_time_for is not None:
+            tenant_est = self.service_time_for(tenant)
+            if tenant_est:  # cold tenants fall back to the global EWMA
+                est = float(tenant_est)
         return max(self.cfg.min_retry_after_s, self._depth * est)

@@ -711,7 +711,8 @@ class ServeEngine:
         # eval-time layout ledger row: per-batch occupancy attributed to
         # the serve layout key (deduped by the ledger across equal rows)
         from fks_tpu.obs.layout import record_layout
-        record_layout("vm_serve" if self.engine_kind == "vm" else "serve",
+        record_layout(getattr(self, "layout_component", None) or
+                      ("vm_serve" if self.engine_kind == "vm" else "serve"),
                       getattr(self, "_layout_key", None) or
                       "shard[candidates]|vmap[candidates]|seg=0",
                       mesh=self.mesh, recorder=self.recorder,
@@ -816,14 +817,27 @@ class ServeEngine:
         wl = Workload(cluster=cluster,
                       pods=_pods_from_dicts(doc.get("base_pods", [])))
         extra = {}
+        portfolio = doc.get("portfolio")
         if doc.get("engine_kind", "aot") == "vm" and cls.engine_kind != "vm":
             # artifact saved by a VMServeEngine: reload it as one (the
-            # champion-as-data executable set, not the AOT ladder)
-            from fks_tpu.serve.vm_engine import VMServeEngine
-            cls = VMServeEngine
+            # champion-as-data executable set, not the AOT ladder) — or,
+            # when the doc carries a portfolio manifest, as the whole
+            # slot table
+            if portfolio:
+                from fks_tpu.portfolio.engine import PortfolioEngine
+                cls = PortfolioEngine
+            else:
+                from fks_tpu.serve.vm_engine import VMServeEngine
+                cls = VMServeEngine
         if cls.engine_kind == "vm" and doc.get("program_capacity"):
             extra["program_capacity"] = int(doc["program_capacity"])
-        eng = cls(ChampionSpec.from_json(doc["champion"]), wl,
+        champ_arg: Any = ChampionSpec.from_json(doc["champion"])
+        if portfolio and getattr(cls, "is_portfolio", False):
+            champ_arg = [ChampionSpec.from_json(c,
+                                                source=c.get("source", ""))
+                         for c in portfolio["slots"]]
+            extra["n_slots"] = int(portfolio["n_slots"])
+        eng = cls(champ_arg, wl,
                   envelope=ShapeEnvelope.from_json(doc["envelope"]),
                   engine=doc["engine"],
                   prefilter_k=int(doc["prefilter_k"]),

@@ -280,6 +280,29 @@ def unpack_program_tables(packed):
                      n_ops=meta[0], out_reg=meta[1])
 
 
+def pack_portfolio_tables(progs) -> tuple:
+    """N ``VMProgram``s -> ONE stacked packed wire pytree: the per-slot
+    ``pack_program_tables`` tuples gain a leading slot axis, so the whole
+    portfolio ships as the same 4 H2D transfers a single champion does
+    (i32[S,4,O] tables / f32[S,O] imm / f32[S,32] consts / i32[S,2]
+    meta). A slot swap re-uploads this block — still a pure table upload,
+    never a recompile."""
+    packed = [pack_program_tables(p) for p in progs]
+    return tuple(np.stack([pk[i] for pk in packed]) for i in range(4))
+
+
+def unpack_portfolio_tables(packed):
+    """Invert ``pack_portfolio_tables`` ON DEVICE: the stacked wire block
+    back into ONE slot-stacked ``VMProgram`` pytree (leading slot axis on
+    every leaf) that ``vm.select_slot`` gathers per lane."""
+    from fks_tpu.funsearch.vm import VMProgram
+
+    tables, imm, consts, meta = packed
+    return VMProgram(opcode=tables[:, 0], a=tables[:, 1], b=tables[:, 2],
+                     c=tables[:, 3], imm=imm, consts=consts,
+                     n_ops=meta[:, 0], out_reg=meta[:, 1])
+
+
 def tree_h2d_bytes(*trees) -> int:
     """Total bytes a host->device upload of these pytrees ships — the
     engine's ``serve_h2d_bytes_per_query`` accounting."""
@@ -415,7 +438,12 @@ class RequestBatcher:
         if self._closed:
             raise RuntimeError("batcher is closed")
         try:
-            self.admission.admit(deadline)
+            # the service's query tuple carries the tenant at index 2
+            # (the _note_expired convention): admission uses it to price
+            # the Retry-After hint at the SHEDDING tenant's service time
+            tenant = (query[2] if isinstance(query, tuple)
+                      and len(query) > 2 else None)
+            self.admission.admit(deadline, tenant=tenant)
         except ShedError as e:
             e.trace_id = tid
             self.recorder.event("shed", reason=e.reason,

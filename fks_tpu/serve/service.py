@@ -84,6 +84,11 @@ class ServeService:
             max_batch=max_batch or engine.envelope.max_batch,
             max_wait_s=max_wait_s, max_queue=max_queue,
             recorder=self.recorder, expired_cb=self._note_expired)
+        if self.accountant is not None:
+            # per-tenant Retry-After: a shed request's back-off hint is
+            # priced at the shedding tenant's own EWMA service time
+            self._batcher.admission.service_time_for = \
+                self.accountant.ewma_service_s
         self._seq = 0
         self._latencies_ms: List[float] = []
         self._t_first: Optional[float] = None
@@ -212,12 +217,21 @@ class ServeService:
         ctx = (trace_ctx.new_trace()
                if getattr(self.recorder, "enabled", False) else None)
         try:
-            return self._batcher.submit((rid, pods, tenant),
-                                        deadline=deadline, ctx=ctx)
+            return self._batcher.submit(
+                self._make_item(rid, pods, tenant, query),
+                deadline=deadline, ctx=ctx)
         except ResilienceError:
             if self.accountant is not None:
                 self.accountant.note_shed(tenant)
             raise
+
+    def _make_item(self, rid: str, pods: List[dict], tenant: str,
+                   query: Dict[str, Any]) -> tuple:
+        """The queue item for one admitted request. Position 0 is the
+        request id, 1 the pod list, 2 the tenant (the batcher's admission
+        and expiry hooks read index 2); subclasses may append routing
+        fields (the portfolio service appends the slot index)."""
+        return (rid, pods, tenant)
 
     def _note_expired(self, item) -> None:
         """Batcher callback: a request's deadline expired while queued —
@@ -255,7 +269,14 @@ class ServeService:
 
     # ----- batch handling (batcher thread)
 
-    def _handle_batch(self, items: List[Tuple[str, List[dict], str]],
+    def _answer(self, engine, items: List[tuple]) -> List[dict]:
+        """One batch through one engine — the routing seam. The base
+        service serves every request on the pinned engine; the portfolio
+        service threads per-request slot indices and splits off
+        coverage-fallback requests here."""
+        return engine.answer_batch([it[1] for it in items])
+
+    def _handle_batch(self, items: List[tuple],
                       enq_times: List[float]) -> List[dict]:
         # pin the engine once per batch: the promotion controller may
         # swap ``self.engine`` concurrently, and a batch must be answered
@@ -264,7 +285,7 @@ class ServeService:
         t_start = time.perf_counter()
         fault: Optional[Tuple[BaseException, float]] = None
         try:
-            answers = engine.answer_batch([pods for _, pods, _ in items])
+            answers = self._answer(engine, items)
         except Exception as e:  # noqa: BLE001 — maybe a device fault
             t_fail = time.perf_counter()
             if self._degrade is None or not self._degrade.on_fault(e):
@@ -274,7 +295,7 @@ class ServeService:
             # failed primary attempt stays on each request's trace
             fault = (e, t_fail - t_start)
             engine = self.engine
-            answers = engine.answer_batch([pods for _, pods, _ in items])
+            answers = self._answer(engine, items)
         done = time.perf_counter()
         inflight = self._batcher.inflight()
         self._trace_batch(engine, inflight, t_start, done, fault)
@@ -282,8 +303,9 @@ class ServeService:
             self._t_first = min(enq_times)
         self._t_last = done
         occupancy = len(items) / self._batcher.max_batch
-        for i, ((rid, pods, tenant), enq, ans) in enumerate(
+        for i, (item, enq, ans) in enumerate(
                 zip(items, enq_times, answers)):
+            rid, pods, tenant = item[0], item[1], item[2]
             latency_ms = (done - enq) * 1e3
             ans["id"] = rid
             ans["latency_ms"] = round(latency_ms, 3)
@@ -583,6 +605,12 @@ def make_http_server(service: ServeService, port: int = 0, *,
         # per-request threads must not outlive the process: a client
         # holding a socket open would otherwise block interpreter exit
         daemon_threads = True
+        # loadgen opens one connection per request from many concurrent
+        # workers; the default listen backlog of 5 intermittently drops
+        # a SYN under bursts, stalling that connect into kernel
+        # retransmit backoff (seconds to ~30 s) and poisoning the
+        # measured elapsed window with one phantom-slow request
+        request_queue_size = 128
 
     server = Server((host, port), Handler)
     return server

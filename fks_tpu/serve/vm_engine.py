@@ -92,6 +92,12 @@ class VMServeEngine(ServeEngine):
         self._transpile_cache_max = 32
         self.transpile_cache_hits = 0
         self.transpile_cache_misses = 0
+        # the cache is shared with shadow views AND (promotion overlap)
+        # a background transpile worker — all access goes under this lock
+        self._transpile_lock = threading.Lock()
+        # code keys whose transpile was overlapped with shadow eval: the
+        # next swap of that champion reports transpile_overlapped=True
+        self._overlap_warmed: set = set()
         # swaps exclude in-flight batches: answer_batch holds this for
         # the whole batch, swap_program for the pointer flip only
         self._swap_lock = threading.RLock()
@@ -111,7 +117,8 @@ class VMServeEngine(ServeEngine):
         self.program_capacity = cap
         # seed the transpile cache: re-swapping the construction
         # champion (rollback after a failed promotion) is a warm swap
-        self._transpile_cache[self._code_key(code, n, g, cap)] = prog
+        with self._transpile_lock:
+            self._transpile_cache[self._code_key(code, n, g, cap)] = prog
         return vm.score_static, prog, "vm"
 
     @staticmethod
@@ -129,18 +136,57 @@ class VMServeEngine(ServeEngine):
         propagates uncached — a rejected champion must re-raise on
         retry, not silently hit."""
         key = self._code_key(code, n, g, self.program_capacity)
-        hit = self._transpile_cache.get(key)
-        if hit is not None:
-            self.transpile_cache_hits += 1
-            self._transpile_cache.move_to_end(key)
-            return hit, "hit"
+        with self._transpile_lock:
+            hit = self._transpile_cache.get(key)
+            if hit is not None:
+                self.transpile_cache_hits += 1
+                self._transpile_cache.move_to_end(key)
+                return hit, "hit"
         prog = vm.pad_capacity(vm.compile_policy(code, n, g),
                                self.program_capacity)
-        self.transpile_cache_misses += 1
-        self._transpile_cache[key] = prog
-        while len(self._transpile_cache) > self._transpile_cache_max:
-            self._transpile_cache.popitem(last=False)
+        with self._transpile_lock:
+            self.transpile_cache_misses += 1
+            self._transpile_cache[key] = prog
+            while len(self._transpile_cache) > self._transpile_cache_max:
+                self._transpile_cache.popitem(last=False)
         return prog, "miss"
+
+    def begin_overlapped_transpile(self, champion: ChampionSpec):
+        """Kick the host-side transpile of ``champion`` on a worker
+        thread — the promotion controller calls this when an attempt
+        enters SHADOW, so the ~60ms ``compile_policy`` on a cache miss
+        overlaps the shadow replay instead of sitting on the commit
+        swap's critical path. The worker lowers THROUGH the shared
+        transpile cache (lock-guarded — a racing swap that gets there
+        first simply wins and the worker hits); the next swap of this
+        champion reports ``transpile_overlapped=True`` in its vm_swap /
+        slot_swap event. ``VMUnsupported`` candidates are swallowed —
+        the swap itself re-raises with full context. Returns the thread
+        (joinable in tests)."""
+        n, g = self.cluster.n_padded, self.cluster.g_padded
+        key = self._code_key(champion.code, n, g, self.program_capacity)
+
+        def _work() -> None:
+            try:
+                self._lower_champion(champion.code, n, g)
+            except vm.VMUnsupported:
+                return
+            with self._transpile_lock:
+                self._overlap_warmed.add(key)
+
+        thread = threading.Thread(target=_work, daemon=True,
+                                  name="vm-transpile-overlap")
+        thread.start()
+        return thread
+
+    def _consume_overlap(self, key: tuple) -> bool:
+        """Whether this swap's transpile was prewarmed by an overlapped
+        worker (one-shot: the flag is consumed)."""
+        with self._transpile_lock:
+            if key in self._overlap_warmed:
+                self._overlap_warmed.discard(key)
+                return True
+            return False
 
     def _upload_program(self, prog: vm.VMProgram):
         """Packed program tables -> device-resident pytree (replicated
@@ -166,6 +212,8 @@ class VMServeEngine(ServeEngine):
         t0 = time.perf_counter()
         n, g = self.cluster.n_padded, self.cluster.g_padded
         prog, cache = self._lower_champion(champion.code, n, g)
+        overlapped = self._consume_overlap(
+            self._code_key(champion.code, n, g, self.program_capacity))
         t1 = time.perf_counter()
         dev = self._upload_program(prog)
         t2 = time.perf_counter()
@@ -184,6 +232,7 @@ class VMServeEngine(ServeEngine):
             "h2d_bytes": h2d,
             "capacity": self.program_capacity,
             "transpile_cache": cache,
+            "transpile_overlapped": overlapped,
             "transpile_cache_hits": self.transpile_cache_hits,
             "transpile_cache_misses": self.transpile_cache_misses,
         }

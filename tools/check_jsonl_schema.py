@@ -87,6 +87,11 @@ EVENT_KIND_REQUIRED: Dict[str, Tuple[str, ...]] = {
     # (outcome="swapped") or per AOT fallback when a champion is outside
     # the VM vocabulary (outcome="fallback")
     "vm_swap": ("outcome", "champion"),
+    # portfolio serving (fks_tpu.portfolio.engine): one event per slot
+    # promotion in the shared slot-vmapped executable — which slot's
+    # tables were re-uploaded (always outcome="swapped"; a champion
+    # outside the VM vocabulary never reaches a slot)
+    "slot_swap": ("slot", "outcome", "champion"),
     # causal tracing (fks_tpu.obs.trace_ctx): one span of a request /
     # generation / promotion trace. parent_id is intentionally NOT
     # required: the root span carries an explicit JSON null there, and
@@ -132,7 +137,12 @@ LOADGEN_MODES = {"open", "closed", "mixed"}
 #: copies) — which axis a LayoutSpec shards/vmaps, and who recorded it
 LAYOUT_AXES = {"candidates", "scenarios", "segments"}
 LAYOUT_COMPONENTS = {"eval", "code_eval", "gen_step", "suite_eval",
-                     "serve", "vm_serve", "probe", "bench"}
+                     "serve", "vm_serve", "portfolio_serve", "probe",
+                     "bench"}
+#: legal ``reason`` values on a portfolio_route metric (duplicated from
+#: fks_tpu.portfolio.router.ROUTE_REASONS; tests/test_portfolio.py pins
+#: the two copies) — which routing rule placed the request
+ROUTE_REASONS = {"pin", "affinity", "ab", "default", "fallback", "query"}
 #: canonical LayoutSpec key shape (fks_tpu.obs.layout.LayoutSpec.key)
 _LAYOUT_KEY_RE = re.compile(
     r"^shard\[[a-z_,]*\]\|vmap\[[a-z_,]*\]\|seg=\d+$")
@@ -207,6 +217,10 @@ METRIC_KIND_REQUIRED: Dict[str, Tuple[str, ...]] = {
     "loadgen_summary": ("mode", "requests", "loadgen_qps",
                         "loadgen_p99_ms", "loadgen_shed_rate",
                         "loadgen_fairness_index"),
+    # portfolio routing (fks_tpu.portfolio.service): one row per routed
+    # request — which slot answered it and which rule decided (slot -1
+    # means the AOT coverage-fallback engine served it)
+    "portfolio_route": ("request_id", "tenant", "slot", "reason"),
     # per-layout cost ledger (fks_tpu.obs.layout): one row per sharded
     # entry point wiring/launch, tagged with the canonical LayoutSpec key
     # and the mesh layout it ran on
@@ -299,12 +313,19 @@ def check_kinds(path: str, records: List[dict],
                     f"{path}: record {i + 1}: unknown rejection taxonomy "
                     f"{tax!r} (expect one of "
                     f"{sorted(CANDIDATE_REJECT_TAXONOMY)})")
-        elif rec.get("kind") == "vm_swap":
+        elif rec.get("kind") in ("vm_swap", "slot_swap"):
             out = rec.get("outcome")
             if out not in VM_SWAP_OUTCOMES:
                 raise SchemaError(
-                    f"{path}: record {i + 1}: unknown vm_swap outcome "
-                    f"{out!r} (expect one of {sorted(VM_SWAP_OUTCOMES)})")
+                    f"{path}: record {i + 1}: unknown {rec['kind']} "
+                    f"outcome {out!r} (expect one of "
+                    f"{sorted(VM_SWAP_OUTCOMES)})")
+        elif rec.get("kind") == "portfolio_route":
+            reason = rec.get("reason")
+            if reason not in ROUTE_REASONS:
+                raise SchemaError(
+                    f"{path}: record {i + 1}: unknown route reason "
+                    f"{reason!r} (expect one of {sorted(ROUTE_REASONS)})")
         elif rec.get("kind") == "memory_footprint":
             comp = rec.get("component")
             if comp not in MEMORY_COMPONENTS:

@@ -101,6 +101,16 @@ fairness index over tenant goodput must stay >= 0.8, and the steady
 state must serve with ZERO recompiles. A failure means the tenant
 accounting, the threaded HTTP front, or the warm serving path
 regressed under overlapping clients. Recorded as ``loadgen_gate``.
+
+A PORTFOLIO GATE follows: multi-tenant champion-portfolio serving —
+``cli portfolio --cpu --devices 8 --selftest 4`` builds four resident
+champions into ONE slot-vmapped VM executable on the 8-device dryrun
+mesh, and must show every slot's answers matching a single-champion VM
+engine (score drift <= 1e-5, placements identical), a mixed-slot batch
+matching the per-slot answers, and one slot promoted mid-traffic with
+ZERO XLA compiles. A failure means the slot-gather dispatch, the
+replicated slot-table sharding, or the swap-under-traffic lock
+regressed. Recorded as ``portfolio_gate``.
 """
 from __future__ import annotations
 
@@ -374,6 +384,32 @@ def loadgen_gate() -> dict:
     return {"ok": ok, **detail}
 
 
+def portfolio_gate() -> dict:
+    """Portfolio serving: ``cli portfolio --selftest`` on the 8-device
+    dryrun mesh — four resident champions in one slot-vmapped VM
+    executable, per-slot + mixed-batch parity vs single-champion VM
+    engines (<= 1e-5), then one slot promoted mid-traffic with zero XLA
+    compiles. Returns {"ok": bool, ...}."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "-m", "fks_tpu.cli", "portfolio", "--cpu",
+         "--devices", "8", "--selftest", "4"],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=900)
+    ok = proc.returncode == 0
+    detail = {"rc": proc.returncode}
+    try:
+        summary = json.loads(proc.stdout)
+        detail["max_drift"] = summary.get("max_drift")
+        detail["mixed_max_drift"] = summary.get("mixed_max_drift")
+        detail["swap_compiles"] = summary.get("swap", {}).get("compiles")
+        detail["n_slots"] = summary.get("n_slots")
+    except json.JSONDecodeError:
+        ok = False
+    if not ok:
+        detail["err"] = (proc.stderr or proc.stdout or "")[-500:]
+    return {"ok": ok, **detail}
+
+
 def layout_gate() -> dict:
     """Layout observability: ``cli layout --explore`` on the 8-device
     dryrun mesh must find >= 2 distinct valid layouts of pop-16 x
@@ -506,6 +542,9 @@ def main() -> int:
     dgate = loadgen_gate()
     if not dgate["ok"]:
         print(f"LOADGEN GATE FAILED: {dgate}", file=sys.stderr)
+    fgate = portfolio_gate()
+    if not fgate["ok"]:
+        print(f"PORTFOLIO GATE FAILED: {fgate}", file=sys.stderr)
     ogate = layout_gate()
     if not ogate["ok"]:
         print(f"LAYOUT GATE FAILED: {ogate}", file=sys.stderr)
@@ -523,7 +562,7 @@ def main() -> int:
                 and hgate["ok"] and lgate["ok"] and ngate["ok"]
                 and pgate["ok"] and rgate["ok"] and wgate["ok"]
                 and mgate["ok"] and ygate["ok"] and dgate["ok"]
-                and ogate["ok"])
+                and fgate["ok"] and ogate["ok"])
     rc = proc.returncode if gates_ok else (proc.returncode or 1)
     row = {"ts": round(time.time(), 1), "rev": rev, "rc": rc,
            "wall_s": wall, **counts, "obs_gate": gate,
@@ -532,8 +571,8 @@ def main() -> int:
            "trends_gate": ngate, "promote_gate": pgate,
            "resilience_gate": rgate, "span_trace_gate": wgate,
            "vm_serve_gate": mgate, "memory_gate": ygate,
-           "loadgen_gate": dgate, "layout_gate": ogate,
-           "summary": summary}
+           "loadgen_gate": dgate, "portfolio_gate": fgate,
+           "layout_gate": ogate, "summary": summary}
     os.makedirs(os.path.dirname(OUT), exist_ok=True)
     with open(OUT, "a") as f:
         f.write(json.dumps(row) + "\n")
